@@ -1,0 +1,35 @@
+"""gpu_provisioner_tpu — a TPU-native accelerator provisioner.
+
+A from-scratch rebuild of the capabilities of Azure/gpu-provisioner (a Karpenter
+``CloudProvider`` materializing AKS GPU agent pools for the KAITO operator —
+see SURVEY.md) re-designed for Google Cloud TPUs: NodeClaim custom resources
+resolve through an accelerator catalog to GKE TPU node pools / Cloud TPU slices
+(v4/v5e/v5p/v6e, single-chip through multi-host), with slice-topology labels
+propagated so JAX/XLA workloads can bootstrap ``jax.distributed`` and build a
+device mesh over ICI/DCN.
+
+Package map (control plane → workload; subpackages land incrementally — see
+git history for what is already built):
+
+- ``apis``            Kubernetes-style API types: karpenter.sh/v1 NodeClaim,
+                      kaito.sh/v1alpha1 KaitoNodeClass, core/v1 subset.
+- ``scheduling``      Requirement/label/taint algebra used to resolve NodeClaims.
+- ``catalog``         The TPU accelerator catalog (requirements → slice shape).
+- ``runtime``         From-scratch controller runtime: object store with watch
+                      semantics, client, rate-limited workqueue, manager.
+- ``cloudprovider``   CloudProvider contract, error taxonomy, metrics decorator,
+                      and the TPU implementation.
+- ``providers``       Instance provider (NodeClaim ⇄ node-pool mapping) and the
+                      narrow GKE/Cloud-TPU client seams + LRO helpers.
+- ``controllers``     NodeClaim lifecycle, node termination, node health/repair,
+                      bidirectional garbage collection.
+- ``operator``        Process runtime: options, logging, probes, metrics server.
+- ``auth``            GCP credential plumbing (ADC / metadata / federated token).
+- ``fake``            Fault-injecting fakes for the cloud APIs and cluster.
+- ``parallel``        Workload side: topology labels → jax Mesh, distributed init.
+- ``ops``             TPU compute primitives (rmsnorm, rope, attention, pallas).
+- ``models``          KAITO-servable model families (Llama, ...) with sharded
+                      train/infer steps.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Kubernetes-style API types (hand-built; no k8s client library exists here).
+
+The reference vendors ``k8s.io/apimachinery`` + the karpenter.sh/v1 NodeClaim
+CRD (see SURVEY.md §2b V10). This package re-creates the load-bearing subset as
+plain dataclasses with camelCase JSON round-tripping, so objects serialize
+exactly like their Kubernetes counterparts (YAML examples, REST payloads, CRD
+storage) while staying idiomatic Python in-process.
+"""

@@ -1,0 +1,163 @@
+"""core/v1 subset: Node, Pod, VolumeAttachment, Event.
+
+Only the fields the controllers actually read/write exist (the reference gets
+the full types from k8s.io/api; the load-bearing subset is what registration
+(registration.go:120-147), initialization (initialization.go:54-77), drain
+(terminator/terminator.go:96-117) and volume-detach wait touch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import ClassVar, Optional
+
+from .meta import Condition, Object, ObjectMeta, register_kind
+
+# Node condition types / taint effects
+NODE_READY = "Ready"
+EFFECT_NO_SCHEDULE = "NoSchedule"
+EFFECT_NO_EXECUTE = "NoExecute"
+EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = EFFECT_NO_SCHEDULE
+
+    def matches(self, other: "Taint") -> bool:
+        return self.key == other.key and self.effect == other.effect
+
+
+@dataclass
+class NodeSystemInfo:
+    architecture: str = "amd64"
+    operating_system: str = "linux"
+    kubelet_version: str = ""
+
+
+@dataclass
+class NodeSpec:
+    provider_id: str = field(default="", metadata={"json": "providerID"})
+    taints: list[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, str] = field(default_factory=dict)
+    allocatable: dict[str, str] = field(default_factory=dict)
+    conditions: list[Condition] = field(default_factory=list)
+    node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
+
+
+@register_kind
+@dataclass
+class Node(Object):
+    API_VERSION: ClassVar[str] = "v1"
+    KIND: ClassVar[str] = "Node"
+    NAMESPACED: ClassVar[bool] = False
+
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    def ready_condition(self) -> Optional[Condition]:
+        for c in self.status.conditions:
+            if c.type == NODE_READY:
+                return c
+        return None
+
+    def is_ready(self) -> bool:
+        c = self.ready_condition()
+        return c is not None and c.status == "True"
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.key and self.key != taint.key:
+            return False
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    priority: int = 0
+    tolerations: list[Toleration] = field(default_factory=list)
+    termination_grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+
+
+@register_kind
+@dataclass
+class Pod(Object):
+    API_VERSION: ClassVar[str] = "v1"
+    KIND: ClassVar[str] = "Pod"
+    NAMESPACED: ClassVar[bool] = True
+
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def is_terminal(self) -> bool:
+        return self.status.phase in ("Succeeded", "Failed")
+
+    def is_owned_by_daemonset(self) -> bool:
+        return any(o.kind == "DaemonSet" for o in self.metadata.owner_references)
+
+
+@dataclass
+class VolumeAttachmentSpec:
+    node_name: str = ""
+    attacher: str = ""
+
+
+@register_kind
+@dataclass
+class VolumeAttachment(Object):
+    API_VERSION: ClassVar[str] = "storage.k8s.io/v1"
+    KIND: ClassVar[str] = "VolumeAttachment"
+    NAMESPACED: ClassVar[bool] = False
+
+    spec: VolumeAttachmentSpec = field(default_factory=VolumeAttachmentSpec)
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@register_kind
+@dataclass
+class Event(Object):
+    """Cluster events published by the recorder (reference: lifecycle/events.go,
+    terminator/events/, health/events.go)."""
+
+    API_VERSION: ClassVar[str] = "v1"
+    KIND: ClassVar[str] = "Event"
+    NAMESPACED: ClassVar[bool] = True
+
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"
+    count: int = 1
+    last_timestamp: Optional[datetime] = None

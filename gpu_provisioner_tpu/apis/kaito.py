@@ -1,0 +1,42 @@
+"""kaito.sh/v1alpha1 KaitoNodeClass.
+
+The reference ships a deliberately empty cluster-scoped NodeClass shell so
+Karpenter's GetSupportedNodeClasses/IsManaged machinery has a GVK to point at
+(pkg/apis/v1alpha1/kaitonodeclass.go:28-50, kaitonodeclass_status.go:23-33 —
+no-op status conditions). The TPU build keeps the shell but gives spec two
+optional, backwards-compatible knobs that are genuinely per-class on GCP.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar
+
+from .meta import Condition, Object, register_kind
+
+GROUP = "kaito.sh"
+
+
+@dataclass
+class KaitoNodeClassSpec:
+    # Optional GCP placement hints; empty means "use controller config".
+    zones: list[str] = field(default_factory=list)
+    reservation: str = ""
+    spot: bool = False
+
+
+@dataclass
+class KaitoNodeClassStatus:
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@register_kind
+@dataclass
+class KaitoNodeClass(Object):
+    API_VERSION: ClassVar[str] = "kaito.sh/v1alpha1"
+    KIND: ClassVar[str] = "KaitoNodeClass"
+    NAMESPACED: ClassVar[bool] = False
+    CONDITION_DEPENDENTS: ClassVar[list[str]] = []
+
+    spec: KaitoNodeClassSpec = field(default_factory=KaitoNodeClassSpec)
+    status: KaitoNodeClassStatus = field(default_factory=KaitoNodeClassStatus)

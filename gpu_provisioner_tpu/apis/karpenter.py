@@ -1,0 +1,93 @@
+"""karpenter.sh/v1 NodeClaim — the provisioning unit of the system.
+
+Hand-built equivalent of the vendored CRD types the reference runs on
+(vendor/sigs.k8s.io/karpenter/pkg/apis/v1/nodeclaim.go and
+nodeclaim_status.go:26-35): spec carries scheduling requirements with
+minValues, resource requests, a nodeClassRef and taints; status carries
+providerID/imageID/capacity plus the lifecycle condition ladder
+Launched → Registered → Initialized (and Drained / VolumesDetached /
+InstanceTerminating during teardown).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, Optional
+
+from .core import Taint
+from .meta import Condition, Object, register_kind
+
+# Status condition types (reference: apis/v1/nodeclaim_status.go:26-35).
+LAUNCHED = "Launched"
+REGISTERED = "Registered"
+INITIALIZED = "Initialized"
+DRAINED = "Drained"
+VOLUMES_DETACHED = "VolumesDetached"
+INSTANCE_TERMINATING = "InstanceTerminating"
+CONSOLIDATABLE = "Consolidatable"
+
+# Requirement operators (corev1.NodeSelectorOperator).
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    """corev1.NodeSelectorRequirement + karpenter's minValues extension
+    (reference: apis/v1/nodeclaim.go NodeSelectorRequirementWithMinValues)."""
+
+    key: str = ""
+    operator: str = IN
+    values: list[str] = field(default_factory=list)
+    min_values: Optional[int] = None
+
+
+@dataclass
+class NodeClassRef:
+    group: str = ""
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    requests: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeClaimSpec:
+    requirements: list[NodeSelectorRequirement] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    node_class_ref: Optional[NodeClassRef] = None
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    termination_grace_period: Optional[str] = None  # metav1.Duration, e.g. "30s"
+    expire_after: Optional[str] = None
+
+
+@dataclass
+class NodeClaimStatus:
+    provider_id: str = field(default="", metadata={"json": "providerID"})
+    image_id: str = field(default="", metadata={"json": "imageID"})
+    node_name: str = ""
+    capacity: dict[str, str] = field(default_factory=dict)
+    allocatable: dict[str, str] = field(default_factory=dict)
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@register_kind
+@dataclass
+class NodeClaim(Object):
+    API_VERSION: ClassVar[str] = "karpenter.sh/v1"
+    KIND: ClassVar[str] = "NodeClaim"
+    NAMESPACED: ClassVar[bool] = False
+    # Ready = Launched ∧ Registered ∧ Initialized (reference: operatorpkg root
+    # condition over the lifecycle dependents).
+    CONDITION_DEPENDENTS: ClassVar[list[str]] = [LAUNCHED, REGISTERED, INITIALIZED]
+
+    spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
+    status: NodeClaimStatus = field(default_factory=NodeClaimStatus)

@@ -1,0 +1,68 @@
+"""Well-known labels, annotations, taints and finalizers.
+
+Karpenter/kaito keys mirror the reference's contract
+(vendor/sigs.k8s.io/karpenter/pkg/apis/v1/labels.go:42-61 and
+pkg/providers/instance/instance.go:39-50); the ``tpu.kaito.sh/*`` group is the
+new slice-topology schema this build adds (SURVEY.md §7 step 1) alongside the
+labels GKE itself stamps on TPU nodes, so JAX pods can target and bootstrap a
+slice (SURVEY.md §2c).
+"""
+
+# --- karpenter.sh core contract -------------------------------------------
+GROUP = "karpenter.sh"
+NODEPOOL_LABEL = "karpenter.sh/nodepool"
+CAPACITY_TYPE_LABEL = "karpenter.sh/capacity-type"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_RESERVED = "reserved"
+
+TERMINATION_FINALIZER = "karpenter.sh/termination"
+UNREGISTERED_TAINT = "karpenter.sh/unregistered"
+DISRUPTED_TAINT = "karpenter.sh/disrupted"
+DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+TERMINATION_TIMESTAMP_ANNOTATION = "karpenter.sh/nodeclaim-termination-timestamp"
+
+# --- kubernetes core -------------------------------------------------------
+INSTANCE_TYPE_LABEL = "node.kubernetes.io/instance-type"
+ARCH_LABEL = "kubernetes.io/arch"
+OS_LABEL = "kubernetes.io/os"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+REGION_LABEL = "topology.kubernetes.io/region"
+
+# --- kaito.sh ownership contract (reference: instance.go:39-50,330-342) ----
+KAITO_NODEPOOL_NAME = "kaito"  # NodePool label value marking kaito-owned capacity
+KAITO_WORKSPACE_LABEL = "kaito.sh/workspace"
+KAITO_RAGENGINE_LABEL = "kaito.sh/ragengine"
+KAITO_MACHINE_TYPE_LABEL = "kaito.sh/machine-type"  # "tpu" | "cpu" (ref: gpu|cpu)
+KAITO_CREATION_TIMESTAMP_LABEL = "kaito.sh/creation-timestamp"
+KAITO_NODE_IMAGE_FAMILY_ANNOTATION = "kaito.sh/node-image-family"
+
+# --- GKE-native TPU node labels (stamped by GKE on TPU node pools) ---------
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+GKE_SPOT_LABEL = "cloud.google.com/gke-spot"
+TPU_RESOURCE_NAME = "google.com/tpu"  # extended resource registered by device plugin
+
+# --- tpu.kaito.sh: the new slice-topology propagation schema ---------------
+# These ride NodeClaim requirements → Instance labels → Node labels so that
+# (a) the catalog can resolve a slice shape and (b) JAX pods can compute their
+# mesh/coordinator (parallel/topology.py consumes them).
+TPU_ACCELERATOR_LABEL = "tpu.kaito.sh/accelerator"     # e.g. "v5e", "v5p"
+TPU_TOPOLOGY_LABEL = "tpu.kaito.sh/topology"           # e.g. "2x4", "2x2x4"
+TPU_CHIPS_LABEL = "tpu.kaito.sh/chips"                 # total chips in slice
+TPU_HOSTS_LABEL = "tpu.kaito.sh/hosts"                 # VM count in slice
+TPU_SLICE_ID_LABEL = "tpu.kaito.sh/slice-id"           # node-pool name
+TPU_WORKER_INDEX_LABEL = "tpu.kaito.sh/worker-index"   # 0..hosts-1, per node
+TPU_SLICE_GROUP_LABEL = "tpu.kaito.sh/slice-group"     # multi-slice DCN group
+
+# Taint applied by GKE to TPU nodes; tolerated by TPU workloads.
+TPU_TAINT = "google.com/tpu"
+
+# e2e test-discovery label (reference: vendor/.../pkg/test/metadata.go:33).
+DISCOVERY_LABEL = "testing/cluster"
+
+# Domains whose labels are controller-managed and synced NodeClaim → Node
+# (reference: registration.go:120-147 syncs all nodeclaim labels).
+MANAGED_LABEL_DOMAINS = ("karpenter.sh", "kaito.sh", "tpu.kaito.sh")

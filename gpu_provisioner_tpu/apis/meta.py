@@ -1,0 +1,197 @@
+"""Object metadata, conditions, and the API-object base machinery.
+
+Re-creates the subset of ``k8s.io/apimachinery`` + ``awslabs/operatorpkg/status``
+the reference actually uses (SURVEY.md §2b V10/V15): ObjectMeta with finalizers
+and deletionTimestamp, owner references, and status conditions with transition
+times and a root ``Ready`` condition computed from declared dependents
+(reference: operatorpkg status conditions, vendored at
+vendor/github.com/awslabs/operatorpkg/status).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import ClassVar, Optional
+
+from .serde import from_dict, now, to_dict
+
+# Condition polarity values (metav1.ConditionStatus).
+TRUE = "True"
+FALSE = "False"
+UNKNOWN = "Unknown"
+
+# Root condition type every object exposes (operatorpkg ConditionReady).
+CONDITION_READY = "Ready"
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    creation_timestamp: Optional[datetime] = None
+    deletion_timestamp: Optional[datetime] = None
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    finalizers: list[str] = field(default_factory=list)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = UNKNOWN
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[datetime] = None
+    observed_generation: int = 0
+
+
+class ConditionSet:
+    """Mutator over an object's ``status.conditions`` list.
+
+    Mirrors operatorpkg's condition semantics: setting a condition bumps
+    ``lastTransitionTime`` only when the status value actually flips, and the
+    root ``Ready`` condition is recomputed from the object's declared
+    ``CONDITION_DEPENDENTS`` after every write.
+    """
+
+    def __init__(self, obj: "Object"):
+        self.obj = obj
+        self.deps: list[str] = list(getattr(obj, "CONDITION_DEPENDENTS", []))
+
+    def _conds(self) -> list[Condition]:
+        return self.obj.status.conditions
+
+    def get(self, ctype: str) -> Optional[Condition]:
+        for c in self._conds():
+            if c.type == ctype:
+                return c
+        return None
+
+    def is_true(self, ctype: str) -> bool:
+        c = self.get(ctype)
+        return c is not None and c.status == TRUE
+
+    def _set(self, ctype: str, status: str, reason: str, message: str) -> bool:
+        c = self.get(ctype)
+        changed = c is None or c.status != status
+        if c is None:
+            c = Condition(type=ctype)
+            self._conds().append(c)
+        if changed:
+            c.last_transition_time = now()
+        c.status = status
+        c.reason = reason or ctype
+        c.message = message
+        c.observed_generation = self.obj.metadata.generation
+        if ctype != CONDITION_READY:
+            self._recompute_ready()
+        return changed
+
+    def set_true(self, ctype: str, reason: str = "", message: str = "") -> bool:
+        return self._set(ctype, TRUE, reason, message)
+
+    def set_false(self, ctype: str, reason: str, message: str = "") -> bool:
+        return self._set(ctype, FALSE, reason, message)
+
+    def set_unknown(self, ctype: str, reason: str = "AwaitingReconciliation",
+                    message: str = "") -> bool:
+        return self._set(ctype, UNKNOWN, reason, message)
+
+    def clear(self, ctype: str) -> None:
+        self.obj.status.conditions = [c for c in self._conds() if c.type != ctype]
+        self._recompute_ready()
+
+    def _recompute_ready(self) -> None:
+        if not self.deps:
+            return
+        worst: Optional[Condition] = None
+        for d in self.deps:
+            c = self.get(d)
+            if c is None or c.status == UNKNOWN:
+                worst = c or Condition(type=d, status=UNKNOWN, reason="AwaitingReconciliation")
+                break
+            if c.status == FALSE:
+                worst = c
+                break
+        if worst is None:
+            self._set(CONDITION_READY, TRUE, "Ready", "")
+        elif worst.status == FALSE:
+            self._set(CONDITION_READY, FALSE, worst.reason, worst.message)
+        else:
+            self._set(CONDITION_READY, UNKNOWN, worst.reason, worst.message)
+
+    def initialize(self) -> None:
+        """Seed Unknown conditions for all dependents not yet present."""
+        for d in self.deps:
+            if self.get(d) is None:
+                self._set(d, UNKNOWN, "AwaitingReconciliation", "object is awaiting reconciliation")
+
+
+@dataclass
+class Object:
+    """Base for all API objects. Subclasses declare API_VERSION/KIND and may
+    declare CONDITION_DEPENDENTS for the Ready-root condition machinery."""
+
+    API_VERSION: ClassVar[str] = ""
+    KIND: ClassVar[str] = ""
+    NAMESPACED: ClassVar[bool] = False
+    CONDITION_DEPENDENTS: ClassVar[list[str]] = []
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    @property
+    def status_conditions(self) -> ConditionSet:
+        return ConditionSet(self)
+
+    def deepcopy(self):
+        import copy
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        d = to_dict(self)
+        d["apiVersion"] = self.API_VERSION
+        d["kind"] = self.KIND
+        return d
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        data = {k: v for k, v in data.items() if k not in ("apiVersion", "kind")}
+        return from_dict(cls, data)
+
+
+# kind registry so the store / envtest loader can round-trip YAML.
+_KINDS: dict[str, type] = {}
+
+
+def register_kind(cls: type) -> type:
+    _KINDS[cls.KIND] = cls
+    return cls
+
+
+def kind_for(name: str) -> type:
+    try:
+        return _KINDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown kind {name!r}; registered kinds: {sorted(_KINDS)}") from None
+
+
+def object_from_manifest(data: dict) -> Object:
+    cls = kind_for(data["kind"])
+    return cls.from_dict(data)

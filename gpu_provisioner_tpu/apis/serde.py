@@ -1,0 +1,115 @@
+"""Dataclass ⇄ camelCase-JSON round-tripping for API objects.
+
+Kubernetes API objects serialize with camelCase keys and RFC3339 timestamps.
+Rather than hand-writing ``to_dict``/``from_dict`` on every type (the Go
+reference gets this from generated deepcopy/json tags), a single generic walker
+handles nested dataclasses, lists, dicts, datetimes and ``Quantity`` strings.
+
+Field-name overrides that don't follow snake→camel (``provider_id`` →
+``providerID``) are declared per-field via ``field(metadata={"json": ...})``.
+Fields that are ``None`` or empty containers are omitted from output, matching
+``omitempty`` semantics in the reference's Go structs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from datetime import datetime, timezone
+from typing import Any, Union, get_args, get_origin, get_type_hints
+
+RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def now() -> datetime:
+    """UTC now, truncated to seconds (Kubernetes metav1.Time resolution)."""
+    return datetime.now(timezone.utc).replace(microsecond=0)
+
+
+def fmt_time(t: datetime) -> str:
+    return t.astimezone(timezone.utc).strftime(RFC3339)
+
+
+def parse_time(s: str) -> datetime:
+    """Parse any RFC3339 timestamp (Z or numeric offset, optional fractional
+    seconds) to a UTC datetime truncated to seconds."""
+    dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.astimezone(timezone.utc).replace(microsecond=0)
+
+
+def snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _json_key(f: dataclasses.Field) -> str:
+    return f.metadata.get("json", snake_to_camel(f.name))
+
+
+def to_dict(obj: Any) -> Any:
+    """Serialize a dataclass (or container of them) to JSON-ready primitives."""
+    if obj is None:
+        return None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            if isinstance(v, (list, dict)) and not v:
+                continue
+            out[_json_key(f)] = to_dict(v)
+        return out
+    if isinstance(obj, datetime):
+        return fmt_time(obj)
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: type, data: Any) -> Any:
+    """Deserialize JSON primitives into dataclass ``cls`` (inverse of to_dict)."""
+    if data is None:
+        return None
+    tp = _unwrap_optional(cls)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return [from_dict(elem, v) for v in data]
+    if origin is dict:
+        args = get_args(tp)
+        val_t = args[1] if len(args) == 2 else Any
+        return {k: from_dict(val_t, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(tp):
+        hints, by_json = _class_info(tp)
+        kwargs = {}
+        for jk, v in (data or {}).items():
+            f = by_json.get(jk)
+            if f is None:
+                continue
+            kwargs[f.name] = from_dict(hints[f.name], v)
+        return tp(**kwargs)
+    if tp is datetime:
+        return parse_time(data) if isinstance(data, str) else data
+    return data
+
+
+@functools.lru_cache(maxsize=None)
+def _class_info(tp: type) -> tuple[dict, dict]:
+    """Cached (type hints, json-key → field) maps — from_dict is on the hot
+    path of every store operation and watch notification."""
+    hints = get_type_hints(tp)
+    by_json = {_json_key(f): f for f in dataclasses.fields(tp)}
+    return hints, by_json

@@ -1,0 +1,213 @@
+"""TPU accelerator catalog: NodeClaim requirements → slice shape.
+
+This is the component the reference *lacks* (SURVEY.md §7 step 2): Azure's
+build passes the VM size string straight through and gates gpu-ness on a
+``Standard_N`` prefix (pkg/providers/instance/instance.go:90-95,335-339).
+A TPU NodeClaim instead resolves to a **slice shape** — accelerator
+generation + ICI topology + host count — because one NodeClaim may
+materialize a multi-host node pool (SURVEY.md §2c).
+
+Naming follows Cloud TPU conventions: v4/v5p slice names count TensorCores
+(2 per chip — ``v5p-32`` = 16 chips = 4 hosts), v5e/v6e count chips
+(``v5e-8`` = 8 chips = 1 host). Aliases (``v5litepod-8``, ``tpu-v5e-8``,
+bare topology strings) all resolve. The tables are data, not code — wrong
+machine-type strings are a one-line fix.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .apis import labels as wk
+from .scheduling import Requirements
+
+
+class UnknownShapeError(Exception):
+    """Requirements did not resolve to any catalog entry."""
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """One provisionable TPU slice shape."""
+
+    name: str              # canonical instance-type value, e.g. "tpu-v5e-8"
+    generation: str        # "v4" | "v5e" | "v5p" | "v6e"
+    slice_name: str        # cloud accelerator-type, e.g. "v5e-8" / "v5p-32"
+    topology: str          # ICI topology, e.g. "2x4" or "2x2x4"
+    chips: int             # total chips in the slice
+    hosts: int             # VMs in the node pool (reference Count=1 → this)
+    chips_per_host: int
+    machine_type: str      # GKE machine type, e.g. "ct5lp-hightpu-8t"
+    gke_accelerator: str   # value for cloud.google.com/gke-tpu-accelerator
+    cores_per_chip: int = 2
+    aliases: tuple[str, ...] = ()
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def ici_dims(self) -> tuple[int, ...]:
+        return tuple(int(d) for d in self.topology.split("x"))
+
+    def node_labels(self, slice_id: str = "") -> dict[str, str]:
+        """Labels every node of this slice carries (GKE-native + tpu.kaito.sh)."""
+        out = {
+            wk.INSTANCE_TYPE_LABEL: self.name,
+            wk.GKE_TPU_ACCELERATOR_LABEL: self.gke_accelerator,
+            wk.GKE_TPU_TOPOLOGY_LABEL: self.topology,
+            wk.TPU_ACCELERATOR_LABEL: self.generation,
+            wk.TPU_TOPOLOGY_LABEL: self.topology,
+            wk.TPU_CHIPS_LABEL: str(self.chips),
+            wk.TPU_HOSTS_LABEL: str(self.hosts),
+            wk.KAITO_MACHINE_TYPE_LABEL: "tpu",
+        }
+        if slice_id:
+            out[wk.TPU_SLICE_ID_LABEL] = slice_id
+        return out
+
+    def per_host_capacity(self) -> dict[str, str]:
+        """Extended-resource capacity one host registers (device plugin view)."""
+        cpu, mem = _HOST_RESOURCES.get(self.machine_type, (96, 448))
+        return {
+            wk.TPU_RESOURCE_NAME: str(self.chips_per_host),
+            "cpu": str(cpu),
+            "memory": f"{mem}Gi",
+        }
+
+
+# (vCPU, memory GiB) per GKE TPU machine type — plausible published values.
+_HOST_RESOURCES = {
+    "ct5lp-hightpu-1t": (24, 48),
+    "ct5lp-hightpu-4t": (112, 192),
+    "ct5lp-hightpu-8t": (224, 400),
+    "ct5p-hightpu-4t": (208, 448),
+    "ct4p-hightpu-4t": (240, 407),
+    "ct6e-standard-1t": (44, 176),
+    "ct6e-standard-4t": (180, 720),
+    "ct6e-standard-8t": (180, 1440),
+}
+
+
+def _v5e_like(gen: str, gke_acc: str, machine_prefix: str,
+              cores_per_chip: int) -> list[SliceShape]:
+    """v5e/v6e family: 2D ICI; 1/4/8-chip hosts; ≥16 chips → 8-chip hosts."""
+    shapes = []
+    single = [("1x1", 1, 1), ("2x2", 4, 4), ("2x4", 8, 8)]
+    multi = [("4x4", 16), ("4x8", 32), ("8x8", 64), ("8x16", 128), ("16x16", 256)]
+    for topo, chips, cph in single:
+        shapes.append(SliceShape(
+            name=f"tpu-{gen}-{chips}", generation=gen, slice_name=f"{gen}-{chips}",
+            topology=topo, chips=chips, hosts=1, chips_per_host=cph,
+            machine_type=f"{machine_prefix}-{cph}t", gke_accelerator=gke_acc,
+            cores_per_chip=cores_per_chip,
+            aliases=(f"v5litepod-{chips}",) if gen == "v5e" else (),
+        ))
+    for topo, chips in multi:
+        shapes.append(SliceShape(
+            name=f"tpu-{gen}-{chips}", generation=gen, slice_name=f"{gen}-{chips}",
+            topology=topo, chips=chips, hosts=chips // 8, chips_per_host=8,
+            machine_type=f"{machine_prefix}-8t", gke_accelerator=gke_acc,
+            cores_per_chip=cores_per_chip,
+            aliases=(f"v5litepod-{chips}",) if gen == "v5e" else (),
+        ))
+    return shapes
+
+
+def _v4_like(gen: str, gke_acc: str, machine_type: str) -> list[SliceShape]:
+    """v4/v5p family: 3D ICI torus; 4-chip hosts; names count TensorCores."""
+    topos = ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8",
+             "4x8x8", "8x8x8", "8x8x16"]
+    shapes = []
+    for topo in topos:
+        chips = math.prod(int(d) for d in topo.split("x"))
+        cores = chips * 2
+        shapes.append(SliceShape(
+            name=f"tpu-{gen}-{cores}", generation=gen, slice_name=f"{gen}-{cores}",
+            topology=topo, chips=chips, hosts=max(1, chips // 4), chips_per_host=min(4, chips),
+            machine_type=machine_type, gke_accelerator=gke_acc,
+        ))
+    return shapes
+
+
+CATALOG: list[SliceShape] = (
+    _v5e_like("v5e", "tpu-v5-lite-podslice", "ct5lp-hightpu", 1)
+    + _v5e_like("v6e", "tpu-v6e-slice", "ct6e-standard", 1)
+    + _v4_like("v5p", "tpu-v5p-slice", "ct5p-hightpu-4t")
+    + _v4_like("v4", "tpu-v4-podslice", "ct4p-hightpu-4t")
+)
+
+_BY_NAME: dict[str, SliceShape] = {}
+for _s in CATALOG:
+    for key in (_s.name, _s.slice_name, *_s.aliases):
+        _BY_NAME.setdefault(key.lower(), _s)
+    # topology-qualified alias, e.g. "v5p/2x2x4"
+    _BY_NAME.setdefault(f"{_s.generation}/{_s.topology}".lower(), _s)
+
+
+def lookup(name: str) -> Optional[SliceShape]:
+    return _BY_NAME.get(name.strip().lower())
+
+
+def smallest_fitting(generation: Optional[str], min_chips: int) -> Optional[SliceShape]:
+    candidates = [s for s in CATALOG
+                  if (generation is None or s.generation == generation)
+                  and s.chips >= min_chips]
+    return min(candidates, key=lambda s: (s.chips, s.hosts), default=None)
+
+
+def resolve(reqs: Requirements, resources: Optional[dict[str, str]] = None) -> SliceShape:
+    """Resolve NodeClaim requirements (+ resource requests) to a slice shape.
+
+    Resolution order (first hit wins), mirroring-then-extending the
+    reference's "first value of the instance-type requirement" rule
+    (instance.go:90-95):
+
+    1. ``node.kubernetes.io/instance-type`` values, in order.
+    2. ``tpu.kaito.sh/accelerator`` (+ optional ``tpu.kaito.sh/topology``).
+    3. ``google.com/tpu`` resource request → smallest fitting shape.
+    """
+    itype_vals = reqs.get(wk.INSTANCE_TYPE_LABEL).values()
+    for v in itype_vals:
+        s = lookup(v)
+        if s is not None:
+            return s
+    if itype_vals:
+        raise UnknownShapeError(
+            f"instance-type values {itype_vals} match no TPU shape "
+            f"(known shapes look like 'tpu-v5e-8', 'v5p-32', 'v5litepod-8')")
+
+    gen_req = reqs.get(wk.TPU_ACCELERATOR_LABEL)
+    gens = [g.lower() for g in gen_req.values()]
+    topo_vals = reqs.get(wk.TPU_TOPOLOGY_LABEL).values()
+    if gens and topo_vals:
+        for g in gens:
+            for t in topo_vals:
+                s = lookup(f"{g}/{t}")
+                if s is not None:
+                    return s
+        raise UnknownShapeError(f"no shape for accelerator {gens} topology {topo_vals}")
+    chips_req = reqs.get(wk.TPU_CHIPS_LABEL).values()
+    if gens and chips_req:
+        s = smallest_fitting(gens[0], int(chips_req[0]))
+        if s is not None:
+            return s
+        raise UnknownShapeError(f"no {gens[0]} shape with >= {chips_req[0]} chips")
+
+    want = int(float((resources or {}).get(wk.TPU_RESOURCE_NAME, 0)))
+    if want > 0:
+        s = smallest_fitting(gens[0] if gens else None, want)
+        if s is not None:
+            return s
+        raise UnknownShapeError(f"no shape with >= {want} chips")
+
+    if gens:
+        s = smallest_fitting(gens[0], 1)
+        if s is not None:
+            return s
+
+    raise UnknownShapeError(
+        "requirements carry no resolvable instance-type, accelerator/topology, "
+        f"or google.com/tpu request (keys: {reqs.keys()})")

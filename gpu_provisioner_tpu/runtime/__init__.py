@@ -1,0 +1,22 @@
+"""From-scratch controller runtime (controller-runtime/client-go analog).
+
+The reference builds on sigs.k8s.io/controller-runtime + a patched Karpenter
+operator (SURVEY.md §2b V9/V15). No Kubernetes client library exists in this
+environment, so the load-bearing subset is rebuilt natively on asyncio:
+
+- ``store``      in-memory API-server: optimistic concurrency, watch streams,
+                 finalizer/deletionTimestamp semantics, field indexes.
+- ``client``     the typed Client seam controllers program against (the same
+                 seam lets a REST-backed client target a real apiserver later).
+- ``workqueue``  rate-limited dedup queue with per-item exponential backoff.
+- ``controller`` Reconciler/Controller/Manager + singleton source.
+"""
+
+from .client import (  # noqa: F401
+    AlreadyExistsError, Client, ConflictError, InMemoryClient, NotFoundError,
+)
+from .controller import (  # noqa: F401
+    Controller, Manager, Reconciler, Request, Result, Singleton,
+)
+from .store import Store, WatchEvent  # noqa: F401
+from .workqueue import RateLimitingQueue  # noqa: F401

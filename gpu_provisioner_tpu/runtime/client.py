@@ -1,0 +1,157 @@
+"""The typed Client seam controllers program against.
+
+Mirrors the controller-runtime ``client.Client`` surface the reference's
+controllers consume (get/list/create/update/status-update/delete + field
+indexes). Two implementations share the seam: ``InMemoryClient`` (envtest and
+unit tests — the reference instead hand-rolls ``pkg/fake/k8sClient.go``) and,
+in production, a REST client speaking to a real apiserver. Keeping the seam
+narrow is what makes the whole tree testable (SURVEY.md §7 step 3 notes the
+same about the 4-method ARM seam).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional, Protocol
+
+from ..apis.meta import Object
+from .store import (
+    Store, StoreAlreadyExists, StoreConflict, StoreNotFound, WatchEvent,
+)
+
+
+class ClientError(Exception):
+    pass
+
+
+class NotFoundError(ClientError):
+    pass
+
+
+class ConflictError(ClientError):
+    pass
+
+
+class AlreadyExistsError(ClientError):
+    pass
+
+
+def ignore_not_found(exc: Optional[Exception]) -> None:
+    if exc is not None and not isinstance(exc, NotFoundError):
+        raise exc
+
+
+class Client(Protocol):
+    async def get(self, cls: type, name: str, namespace: str = "") -> Object: ...
+    async def list(self, cls: type, labels: Optional[dict[str, str]] = None,
+                   namespace: Optional[str] = None,
+                   index: Optional[tuple[str, str]] = None) -> list[Object]: ...
+    async def create(self, obj: Object) -> Object: ...
+    async def update(self, obj: Object) -> Object: ...
+    async def update_status(self, obj: Object) -> Object: ...
+    async def delete(self, cls: type, name: str, namespace: str = "") -> None: ...
+    def watch(self, cls: type) -> "Watch": ...
+
+
+_CLOSED = object()
+
+
+class Watch:
+    """Async iterator over a store watch queue. ``close()`` is idempotent and
+    wakes any consumer blocked in ``__anext__``."""
+
+    def __init__(self, store: Store, cls: type):
+        self._store = store
+        self._cls = cls
+        self._q = store.watch(cls)
+        self._closed = False
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._closed:
+            raise StopAsyncIteration
+        ev = await self._q.get()
+        if ev is _CLOSED or self._closed:
+            raise StopAsyncIteration
+        return ev
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._store.unwatch(self._cls, self._q)
+        self._q.put_nowait(_CLOSED)
+
+
+_ERR_MAP = {
+    StoreNotFound: NotFoundError,
+    StoreConflict: ConflictError,
+    StoreAlreadyExists: AlreadyExistsError,
+}
+
+
+def _translate(fn):
+    async def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except tuple(_ERR_MAP) as e:
+            raise _ERR_MAP[type(e)](str(e)) from e
+    return wrapper
+
+
+class InMemoryClient:
+    """Client over the in-memory Store. All mutations are synchronous under the
+    event loop, but the surface is async to match the REST implementation."""
+
+    def __init__(self, store: Optional[Store] = None):
+        self.store = store or Store()
+
+    async def get(self, cls, name, namespace=""):
+        return await _translate(self.store.get)(cls, name, namespace)
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        return await _translate(self.store.list)(cls, labels, namespace, index)
+
+    async def create(self, obj):
+        return await _translate(self.store.create)(obj)
+
+    async def update(self, obj):
+        return await _translate(self.store.update)(obj)
+
+    async def update_status(self, obj):
+        return await _translate(self.store.update_status)(obj)
+
+    async def delete(self, cls, name, namespace=""):
+        return await _translate(self.store.delete)(cls, name, namespace)
+
+    def watch(self, cls) -> Watch:
+        return Watch(self.store, cls)
+
+
+async def patch_retry(client: Client, cls: type, name: str, mutate,
+                      namespace: str = "", status: bool = False,
+                      attempts: int = 5) -> Optional[Object]:
+    """Optimistic-concurrency retry helper: get → mutate(obj) → update.
+
+    ``mutate`` returns False to abort (no write). Retries on conflict, which
+    is how controller-runtime's RetryOnConflict is used throughout the
+    reference's sub-reconcilers.
+    """
+    for i in range(attempts):
+        try:
+            obj = await client.get(cls, name, namespace)
+        except NotFoundError:
+            return None
+        if mutate(obj) is False:
+            return obj
+        try:
+            if status:
+                return await client.update_status(obj)
+            return await client.update(obj)
+        except ConflictError:
+            if i == attempts - 1:
+                raise
+            await asyncio.sleep(0.01 * (2 ** i))
+    return None

@@ -1,0 +1,188 @@
+"""Reconciler / Controller / Manager — the control loop engine.
+
+Replicates the controller-runtime behaviors the reference's controllers are
+built on: level-triggered reconciles fed by watches, per-controller worker
+pools with a rate-limited workqueue, ``Result{requeue_after}`` contracts, and
+operatorpkg's singleton pattern (a controller driven by a synthetic
+self-requeuing source — reference:
+vendor/github.com/awslabs/operatorpkg/singleton/controller.go) used by both
+GC loops (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Awaitable, Callable, Optional, Protocol
+
+from ..apis.meta import Object
+from .client import Client
+from .store import WatchEvent
+from .workqueue import RateLimitingQueue
+
+log = logging.getLogger("runtime.controller")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+
+@dataclasses.dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: Optional[float] = None
+
+
+class Reconciler(Protocol):
+    async def reconcile(self, req: Request) -> Result: ...
+
+
+MapFn = Callable[[Object], list[Request]]
+Predicate = Callable[[Object], bool]
+
+
+def _default_map(obj: Object) -> list[Request]:
+    return [Request(name=obj.metadata.name, namespace=obj.metadata.namespace)]
+
+
+@dataclasses.dataclass
+class _Source:
+    cls: type
+    map_fn: MapFn
+    predicate: Optional[Predicate]
+
+
+SINGLETON_REQUEST = Request(name="singleton")
+
+
+class Controller:
+    """One reconcile loop: watch sources → workqueue → N workers."""
+
+    def __init__(self, name: str, reconciler: Reconciler, max_concurrent: int = 10):
+        self.name = name
+        self.reconciler = reconciler
+        self.max_concurrent = max_concurrent
+        self.queue = RateLimitingQueue()
+        self.sources: list[_Source] = []
+        self.singleton = False
+        self._metrics_hook: Optional[Callable[[str, float, Optional[str]], None]] = None
+
+    def watches(self, cls: type, map_fn: Optional[MapFn] = None,
+                predicate: Optional[Predicate] = None) -> "Controller":
+        self.sources.append(_Source(cls, map_fn or _default_map, predicate))
+        return self
+
+    def as_singleton(self) -> "Controller":
+        self.singleton = True
+        return self
+
+    def set_metrics_hook(self, hook) -> None:
+        self._metrics_hook = hook
+
+    # -- run --------------------------------------------------------------
+    async def _pump(self, client: Client, src: _Source) -> None:
+        w = client.watch(src.cls)
+        try:
+            async for ev in w:
+                if src.predicate is not None and not src.predicate(ev.object):
+                    continue
+                for req in src.map_fn(ev.object):
+                    await self.queue.add(req)
+        finally:
+            w.close()
+
+    async def _worker(self) -> None:
+        while True:
+            req = await self.queue.get()
+            start = time.monotonic()
+            err: Optional[str] = None
+            try:
+                result = await self.reconciler.reconcile(req)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # reconcile errors → rate-limited requeue
+                err = type(e).__name__
+                log.warning("controller=%s req=%s reconcile error: %s",
+                            self.name, req, e, exc_info=True)
+                await self.queue.done(req)
+                await self.queue.add_rate_limited(req)
+            else:
+                await self.queue.forget(req)
+                await self.queue.done(req)
+                if result and result.requeue_after is not None:
+                    await self.queue.add_after(req, result.requeue_after)
+                elif result and result.requeue:
+                    await self.queue.add_rate_limited(req)
+            finally:
+                if self._metrics_hook is not None:
+                    self._metrics_hook(self.name, time.monotonic() - start, err)
+
+    async def run(self, client: Client) -> list[asyncio.Task]:
+        tasks = [asyncio.create_task(self._pump(client, s), name=f"{self.name}/pump")
+                 for s in self.sources]
+        if self.singleton:
+            await self.queue.add(SINGLETON_REQUEST)
+        tasks += [asyncio.create_task(self._worker(), name=f"{self.name}/worker-{i}")
+                  for i in range(self.max_concurrent)]
+        return tasks
+
+
+class Singleton:
+    """Wrap a ``async reconcile_singleton() -> float`` (returns next interval)
+    into a Reconciler."""
+
+    def __init__(self, fn: Callable[[], Awaitable[float]]):
+        self.fn = fn
+
+    async def reconcile(self, req: Request) -> Result:
+        interval = await self.fn()
+        return Result(requeue_after=interval)
+
+
+class Manager:
+    """Holds the client, registered controllers and indexes; runs everything.
+
+    The reference's manager additionally does leader election — disabled by
+    default there (DISABLE_LEADER_ELECTION=true,
+    vendor/.../operator/options/options.go:117) and single-replica in the
+    chart, so a no-op here is behavior-preserving; the seam stays.
+    """
+
+    def __init__(self, client: Client):
+        self.client = client
+        self.controllers: list[Controller] = []
+        self._tasks: list[asyncio.Task] = []
+        self.started = asyncio.Event()
+
+    def register(self, *controllers: Controller) -> "Manager":
+        self.controllers.extend(controllers)
+        return self
+
+    def index(self, cls: type, name: str, key_fn) -> None:
+        store = getattr(self.client, "store", None)
+        if store is not None:
+            store.add_index(cls, name, key_fn)
+
+    async def start(self) -> None:
+        for c in self.controllers:
+            self._tasks += await c.run(self.client)
+        # Yield once so watch pumps register before callers mutate state.
+        await asyncio.sleep(0)
+        self.started.set()
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()

@@ -1,0 +1,47 @@
+"""Event recorder: publishes corev1 Events for object lifecycle moments.
+
+Analog of the record.EventRecorder the reference controllers use to surface
+insufficient-capacity / eviction / repair events (reference: lifecycle/events.go,
+terminator/events/, health/events.go). Dedupes by (involved UID, reason) with
+a count bump, like the apiserver's event aggregation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..apis.core import Event, ObjectReference
+from ..apis.meta import Object, ObjectMeta
+from ..apis.serde import now
+from .client import Client, NotFoundError
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+class Recorder:
+    def __init__(self, client: Client, namespace: str = "default"):
+        self.client = client
+        self.namespace = namespace
+
+    async def publish(self, obj: Object, etype: str, reason: str, message: str) -> None:
+        h = hashlib.sha1(f"{obj.metadata.uid}/{reason}".encode()).hexdigest()[:16]
+        name = f"{obj.metadata.name}.{h}"
+        ref = ObjectReference(kind=obj.KIND, namespace=obj.metadata.namespace,
+                              name=obj.metadata.name, uid=obj.metadata.uid)
+        try:
+            ev = await self.client.get(Event, name, self.namespace)
+            ev.count += 1
+            ev.last_timestamp = now()
+            ev.message = message
+            await self.client.update(ev)
+        except NotFoundError:
+            await self.client.create(Event(
+                metadata=ObjectMeta(name=name, namespace=self.namespace),
+                involved_object=ref, reason=reason, message=message,
+                type=etype, count=1, last_timestamp=now()))
+
+
+class NoopRecorder:
+    async def publish(self, obj, etype, reason, message) -> None:
+        return None

@@ -1,0 +1,215 @@
+"""In-memory API-server: the storage + watch half of the runtime.
+
+Replicates the API-server behaviors the reference's controllers depend on
+(they talk to a real apiserver through controller-runtime's cached client):
+
+- monotonically increasing resourceVersion with optimistic-concurrency
+  conflicts on update;
+- watch streams delivering ADDED/MODIFIED/DELETED events per kind;
+- finalizer semantics: delete of an object with finalizers only sets
+  ``deletionTimestamp``; the object is actually removed when its finalizer
+  list empties (this is what makes the termination flows in SURVEY.md §3.3
+  work at all);
+- ``generation`` bump on spec change, stable across status-only updates.
+
+Used directly by envtest-style tests and ``fake``; production deployments
+swap in the REST client behind the same ``Client`` seam.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis.meta import Object
+from ..apis.serde import now
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    object: Object
+
+
+class StoreError(Exception):
+    pass
+
+
+class StoreNotFound(StoreError):
+    pass
+
+
+class StoreConflict(StoreError):
+    pass
+
+
+class StoreAlreadyExists(StoreError):
+    pass
+
+
+def _key(namespace: str, name: str) -> tuple[str, str]:
+    return (namespace or "", name)
+
+
+class Store:
+    def __init__(self):
+        self._objects: dict[type, dict[tuple[str, str], Object]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: dict[type, list[asyncio.Queue]] = {}
+        self._indexes: dict[tuple[type, str], object] = {}  # (cls, name) -> key_fn
+
+    # -- watch ------------------------------------------------------------
+    def watch(self, cls: type, initial_list: bool = True) -> asyncio.Queue:
+        """Register a watch stream. ``initial_list`` replays existing objects
+        as ADDED events first — informer ListAndWatch semantics, which the
+        reference's controllers get from controller-runtime caches. Without
+        it, objects created before a controller starts would never reconcile.
+
+        Queues are unbounded: an in-process watcher that falls behind must
+        still eventually see every event (there is no relist protocol like the
+        real apiserver's 410 Gone → relist), and memory is bounded by event
+        volume, which the workqueue dedups right behind the pump."""
+        q: asyncio.Queue = asyncio.Queue()
+        if initial_list:
+            for obj in self._bucket(cls).values():
+                q.put_nowait(WatchEvent(ADDED, obj.deepcopy()))
+        self._watchers.setdefault(cls, []).append(q)
+        return q
+
+    def unwatch(self, cls: type, q: asyncio.Queue) -> None:
+        ws = self._watchers.get(cls, [])
+        if q in ws:
+            ws.remove(q)
+
+    def _notify(self, etype: str, obj: Object) -> None:
+        for q in self._watchers.get(type(obj), []):
+            q.put_nowait(WatchEvent(etype, obj.deepcopy()))
+
+    # -- index ------------------------------------------------------------
+    def add_index(self, cls: type, name: str, key_fn) -> None:
+        """Field indexer analog (reference: operator.go:263-293 registers pod
+        nodeName / node providerID / nodeclaim providerID indexes)."""
+        self._indexes[(cls, name)] = key_fn
+
+    # -- CRUD -------------------------------------------------------------
+    def _bucket(self, cls: type) -> dict[tuple[str, str], Object]:
+        return self._objects.setdefault(cls, {})
+
+    def create(self, obj: Object) -> Object:
+        b = self._bucket(type(obj))
+        k = _key(obj.metadata.namespace, obj.metadata.name)
+        if k in b:
+            raise StoreAlreadyExists(f"{type(obj).__name__} {k} exists")
+        stored = obj.deepcopy()
+        stored.metadata.uid = stored.metadata.uid or str(uuid.uuid4())
+        stored.metadata.creation_timestamp = stored.metadata.creation_timestamp or now()
+        stored.metadata.generation = 1
+        stored.metadata.resource_version = str(next(self._rv))
+        b[k] = stored
+        self._notify(ADDED, stored)
+        return stored.deepcopy()
+
+    def get(self, cls: type, name: str, namespace: str = "") -> Object:
+        obj = self._bucket(cls).get(_key(namespace, name))
+        if obj is None:
+            raise StoreNotFound(f"{cls.__name__} {namespace}/{name} not found")
+        return obj.deepcopy()
+
+    def list(self, cls: type, labels: Optional[dict[str, str]] = None,
+             namespace: Optional[str] = None,
+             index: Optional[tuple[str, str]] = None) -> list[Object]:
+        out = []
+        key_fn = self._indexes.get((cls, index[0])) if index else None
+        for obj in self._bucket(cls).values():
+            if namespace is not None and obj.metadata.namespace != namespace:
+                continue
+            if labels and any(obj.metadata.labels.get(k) != v for k, v in labels.items()):
+                continue
+            if index:
+                if key_fn is None:
+                    raise StoreError(f"no index {index[0]!r} registered for {cls.__name__}")
+                if index[1] not in (key_fn(obj) or []):
+                    continue
+            out.append(obj.deepcopy())
+        return out
+
+    def _check_conflict(self, current: Object, incoming: Object) -> None:
+        # The real apiserver rejects updates without a resourceVersion; allowing
+        # them here would let lost-update bugs pass envtest and fail only in
+        # production.
+        if not incoming.metadata.resource_version:
+            raise StoreConflict(
+                f"{type(incoming).__name__} {incoming.metadata.name}: "
+                "resourceVersion must be specified for an update")
+        if incoming.metadata.resource_version != current.metadata.resource_version:
+            raise StoreConflict(
+                f"{type(incoming).__name__} {incoming.metadata.name}: resourceVersion "
+                f"{incoming.metadata.resource_version} != {current.metadata.resource_version}")
+
+    def update(self, obj: Object) -> Object:
+        b = self._bucket(type(obj))
+        k = _key(obj.metadata.namespace, obj.metadata.name)
+        current = b.get(k)
+        if current is None:
+            raise StoreNotFound(f"{type(obj).__name__} {k} not found")
+        self._check_conflict(current, obj)
+        stored = obj.deepcopy()
+        # Immutable server-side fields.
+        stored.metadata.uid = current.metadata.uid
+        stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        # deletionTimestamp is server-owned: only delete() sets it.
+        stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+        if hasattr(current, "spec") and to_comparable(current.spec) != to_comparable(stored.spec):
+            stored.metadata.generation = current.metadata.generation + 1
+        else:
+            stored.metadata.generation = current.metadata.generation
+        stored.metadata.resource_version = str(next(self._rv))
+        if stored.metadata.deletion_timestamp and not stored.metadata.finalizers:
+            del b[k]
+            self._notify(DELETED, stored)
+            return stored.deepcopy()
+        b[k] = stored
+        self._notify(MODIFIED, stored)
+        return stored.deepcopy()
+
+    def update_status(self, obj: Object) -> Object:
+        """Status-subresource write: only .status changes, generation stable."""
+        b = self._bucket(type(obj))
+        k = _key(obj.metadata.namespace, obj.metadata.name)
+        current = b.get(k)
+        if current is None:
+            raise StoreNotFound(f"{type(obj).__name__} {k} not found")
+        self._check_conflict(current, obj)
+        stored = current.deepcopy()
+        stored.status = obj.deepcopy().status
+        stored.metadata.resource_version = str(next(self._rv))
+        b[k] = stored
+        self._notify(MODIFIED, stored)
+        return stored.deepcopy()
+
+    def delete(self, cls: type, name: str, namespace: str = "") -> None:
+        b = self._bucket(cls)
+        k = _key(namespace, name)
+        current = b.get(k)
+        if current is None:
+            raise StoreNotFound(f"{cls.__name__} {namespace}/{name} not found")
+        if current.metadata.finalizers:
+            if current.metadata.deletion_timestamp is None:
+                current.metadata.deletion_timestamp = now()
+                current.metadata.resource_version = str(next(self._rv))
+                self._notify(MODIFIED, current)
+            return
+        del b[k]
+        self._notify(DELETED, current)
+
+
+def to_comparable(obj) -> object:
+    from ..apis.serde import to_dict
+    return to_dict(obj)

@@ -1,0 +1,110 @@
+"""Rate-limited dedup workqueue (client-go workqueue analog).
+
+Semantics replicated from client-go, which every reference controller relies
+on: an item present in the queue is not added twice; an item re-added while a
+worker is processing it is re-queued after ``done``; ``add_rate_limited``
+applies per-item exponential backoff (5 ms → 1000 s, client-go's default
+failure rate limiter) cleared by ``forget``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import Any, Hashable, Optional
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._queue: list[Hashable] = []
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._failures: dict[Hashable, int] = {}
+        self._delayed: list[tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._cond = asyncio.Condition()
+        self._shutdown = False
+
+    # -- core add/get/done ------------------------------------------------
+    def _add_locked(self, item: Hashable) -> None:
+        if self._shutdown or item in self._dirty:
+            return
+        self._dirty.add(item)
+        if item in self._processing:
+            return  # will be re-queued on done()
+        self._queue.append(item)
+        self._cond.notify()
+
+    async def add(self, item: Hashable) -> None:
+        async with self._cond:
+            self._add_locked(item)
+
+    async def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            await self.add(item)
+            return
+        async with self._cond:
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    async def add_rate_limited(self, item: Hashable) -> None:
+        async with self._cond:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        await self.add_after(item, min(self.base_delay * (2 ** n), self.max_delay))
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._failures.get(item, 0)
+
+    async def forget(self, item: Hashable) -> None:
+        async with self._cond:
+            self._failures.pop(item, None)
+
+    def _drain_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the queue; return seconds to next due."""
+        nxt = None
+        now = time.monotonic()
+        while self._delayed:
+            due, _, item = self._delayed[0]
+            if due <= now:
+                heapq.heappop(self._delayed)
+                self._add_locked(item)
+            else:
+                nxt = due - now
+                break
+        return nxt
+
+    async def get(self) -> Any:
+        async with self._cond:
+            while True:
+                timeout = self._drain_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    raise asyncio.CancelledError("workqueue shut down")
+                try:
+                    await asyncio.wait_for(self._cond.wait(), timeout)
+                except asyncio.TimeoutError:
+                    continue
+
+    async def done(self, item: Hashable) -> None:
+        async with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    async def shutdown(self) -> None:
+        async with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        return len(self._queue)

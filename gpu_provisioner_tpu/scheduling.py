@@ -1,0 +1,111 @@
+"""Scheduling requirement/label/taint algebra.
+
+Subset of the vendored karpenter scheduling library the reference leans on
+(SURVEY.md §2b V14; used at pkg/providers/instance/instance.go:90-95 to resolve
+the instance type and at registration.go:120-147 for taint/label sync). The
+full Offerings engine is deliberately not built — the reference's
+GetInstanceTypes returns an empty catalog (pkg/cloudprovider/cloudprovider.go:99-101)
+and KAITO pins exact shapes via requirements.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .apis import karpenter as kv1
+from .apis.core import Taint
+
+
+class Requirement:
+    """The allowed value set for one label key."""
+
+    def __init__(self, key: str, operator: str, values: Iterable[str] = (),
+                 min_values: Optional[int] = None):
+        self.key = key
+        self.operator = operator
+        self.raw_values = list(values)
+        self.min_values = min_values
+
+    def values(self) -> list[str]:
+        """Allowed values, in declaration order (only meaningful for In)."""
+        return list(self.raw_values) if self.operator == kv1.IN else []
+
+    def any(self) -> str:
+        vals = self.values()
+        return vals[0] if vals else ""
+
+    def matches(self, value: Optional[str]) -> bool:
+        op = self.operator
+        if op == kv1.IN:
+            return value is not None and value in self.raw_values
+        if op == kv1.NOT_IN:
+            return value is None or value not in self.raw_values
+        if op == kv1.EXISTS:
+            return value is not None
+        if op == kv1.DOES_NOT_EXIST:
+            return value is None
+        if op in (kv1.GT, kv1.LT):
+            if not self.raw_values or not self.raw_values[0].lstrip("-").isdigit():
+                return False
+            if value is None or not value.lstrip("-").isdigit():
+                return False
+            bound = int(self.raw_values[0])
+            return int(value) > bound if op == kv1.GT else int(value) < bound
+        return False
+
+
+class Requirements:
+    """Keyed collection of Requirements built from a NodeClaim spec."""
+
+    def __init__(self, reqs: Iterable[kv1.NodeSelectorRequirement] = ()):
+        self._by_key: dict[str, Requirement] = {}
+        for r in reqs:
+            self.add(Requirement(r.key, r.operator, r.values, r.min_values))
+
+    @classmethod
+    def from_nodeclaim(cls, nc: kv1.NodeClaim) -> "Requirements":
+        reqs = cls(nc.spec.requirements)
+        # Labels act as implicit In-requirements (karpenter semantics).
+        for k, v in nc.metadata.labels.items():
+            if k not in reqs._by_key:
+                reqs.add(Requirement(k, kv1.IN, [v]))
+        return reqs
+
+    def add(self, req: Requirement) -> None:
+        existing = self._by_key.get(req.key)
+        if existing is not None and existing.operator == kv1.IN and req.operator == kv1.IN:
+            # Intersect allowed sets, preserving the established order.
+            keep = [v for v in existing.raw_values if v in req.raw_values]
+            existing.raw_values = keep
+            return
+        self._by_key[req.key] = req
+
+    def get(self, key: str) -> Requirement:
+        return self._by_key.get(key) or Requirement(key, kv1.DOES_NOT_EXIST)
+
+    def has(self, key: str) -> bool:
+        return key in self._by_key
+
+    def keys(self) -> list[str]:
+        return list(self._by_key)
+
+    def compatible(self, labels: dict[str, str]) -> bool:
+        return all(r.matches(labels.get(k)) for k, r in self._by_key.items())
+
+
+def merge_taints(existing: list[Taint], desired: list[Taint]) -> list[Taint]:
+    """Union by (key, effect), desired wins — the merge registration applies
+    when syncing NodeClaim taints onto the Node (registration.go:120-147)."""
+    out = list(desired)
+    for t in existing:
+        if not any(t.matches(d) for d in desired):
+            out.append(t)
+    return out
+
+
+def remove_taint(taints: list[Taint], key: str) -> list[Taint]:
+    return [t for t in taints if t.key != key]
+
+
+def has_taint(taints: list[Taint], key: str) -> bool:
+    return any(t.key == key for t in taints)

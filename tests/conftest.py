@@ -1,0 +1,37 @@
+"""Test bootstrap.
+
+JAX-touching tests run on a virtual 8-device CPU mesh (the reference tests
+distributed behavior without a cluster via fakes — SURVEY.md §4.2; here the
+sharding path additionally gets real multi-device execution on host CPU).
+The env vars must be set before the first ``import jax`` anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio
+import functools
+
+import pytest
+
+
+def async_test(fn):
+    """Run an async test function to completion (no pytest-asyncio here)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+
+    return wrapper
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,100 @@
+"""API machinery: serde round-trips, conditions, manifest loading."""
+
+import yaml
+
+from gpu_provisioner_tpu.apis import karpenter as kv1
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Node, NodeSpec, Taint
+from gpu_provisioner_tpu.apis.meta import (
+    FALSE, TRUE, UNKNOWN, CONDITION_READY, ObjectMeta, object_from_manifest,
+)
+from gpu_provisioner_tpu.apis.serde import now, parse_time
+
+
+def make_nodeclaim(name="ws0", shape="tpu-v5e-8"):
+    return kv1.NodeClaim(
+        metadata=ObjectMeta(name=name, labels={
+            wk.KAITO_WORKSPACE_LABEL: "ws",
+            wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,
+        }),
+        spec=kv1.NodeClaimSpec(
+            requirements=[kv1.NodeSelectorRequirement(
+                key=wk.INSTANCE_TYPE_LABEL, operator=kv1.IN, values=[shape])],
+            resources=kv1.ResourceRequirements(requests={"storage": "50Gi"}),
+            node_class_ref=kv1.NodeClassRef(group="kaito.sh", kind="KaitoNodeClass", name="default"),
+        ),
+    )
+
+
+def test_serde_roundtrip_camelcase():
+    nc = make_nodeclaim()
+    nc.status.provider_id = "gce://p/us-central2-b/pool-0"
+    d = nc.to_dict()
+    assert d["apiVersion"] == "karpenter.sh/v1"
+    assert d["kind"] == "NodeClaim"
+    assert d["spec"]["nodeClassRef"]["kind"] == "KaitoNodeClass"
+    assert d["status"]["providerID"].startswith("gce://")
+    back = kv1.NodeClaim.from_dict(d)
+    assert back.spec.requirements[0].key == wk.INSTANCE_TYPE_LABEL
+    assert back.status.provider_id == nc.status.provider_id
+    assert back.metadata.labels == nc.metadata.labels
+
+
+def test_time_roundtrip():
+    t = now()
+    assert parse_time(t.strftime("%Y-%m-%dT%H:%M:%SZ")) == t
+
+
+def test_conditions_ready_ladder():
+    nc = make_nodeclaim()
+    cs = nc.status_conditions
+    cs.initialize()
+    assert cs.get(CONDITION_READY).status == UNKNOWN
+    cs.set_true(kv1.LAUNCHED)
+    cs.set_true(kv1.REGISTERED)
+    assert cs.get(CONDITION_READY).status == UNKNOWN  # Initialized still unknown
+    cs.set_true(kv1.INITIALIZED)
+    assert cs.get(CONDITION_READY).status == TRUE
+    cs.set_false(kv1.REGISTERED, "NodeGone")
+    assert cs.get(CONDITION_READY).status == FALSE
+    assert cs.get(CONDITION_READY).reason == "NodeGone"
+
+
+def test_condition_transition_time_stable():
+    nc = make_nodeclaim()
+    cs = nc.status_conditions
+    cs.set_true(kv1.LAUNCHED, "r1")
+    t1 = cs.get(kv1.LAUNCHED).last_transition_time
+    cs.set_true(kv1.LAUNCHED, "r2")  # same status → transition time unchanged
+    assert cs.get(kv1.LAUNCHED).last_transition_time == t1
+
+
+def test_manifest_loading_and_deepcopy():
+    y = """
+apiVersion: karpenter.sh/v1
+kind: NodeClaim
+metadata:
+  name: ws-tpu
+  labels:
+    kaito.sh/workspace: ws
+spec:
+  requirements:
+    - key: node.kubernetes.io/instance-type
+      operator: In
+      values: ["tpu-v5p-32"]
+"""
+    obj = object_from_manifest(yaml.safe_load(y))
+    assert isinstance(obj, kv1.NodeClaim)
+    cp = obj.deepcopy()
+    cp.metadata.labels["x"] = "y"
+    assert "x" not in obj.metadata.labels
+
+
+def test_node_ready_and_taints():
+    n = Node(metadata=ObjectMeta(name="n0"),
+             spec=NodeSpec(provider_id="gce://p/z/i",
+                           taints=[Taint(key=wk.UNREGISTERED_TAINT)]))
+    assert not n.is_ready()
+    from gpu_provisioner_tpu.apis.meta import Condition
+    n.status.conditions.append(Condition(type="Ready", status=TRUE))
+    assert n.is_ready()

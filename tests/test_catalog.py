@@ -1,0 +1,96 @@
+"""Catalog + scheduling: requirements → slice shape resolution."""
+
+import pytest
+
+from gpu_provisioner_tpu import catalog
+from gpu_provisioner_tpu.apis import karpenter as kv1
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.scheduling import Requirements
+
+from .test_apis import make_nodeclaim
+
+
+def reqs(*pairs, labels=None):
+    nc = make_nodeclaim()
+    nc.spec.requirements = [
+        kv1.NodeSelectorRequirement(key=k, operator=op, values=list(vals))
+        for (k, op, vals) in pairs
+    ]
+    nc.metadata.labels = labels or {}
+    return Requirements.from_nodeclaim(nc)
+
+
+def test_instance_type_first_value_wins():
+    r = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["tpu-v5e-8", "tpu-v5p-32"]))
+    s = catalog.resolve(r)
+    assert s.generation == "v5e" and s.chips == 8 and s.hosts == 1
+    assert s.topology == "2x4" and s.machine_type == "ct5lp-hightpu-8t"
+
+
+def test_v5p_32_is_four_hosts():
+    # v5p-32 counts TensorCores: 16 chips, 4 hosts on a 2x2x4 ICI torus
+    # (BASELINE.json multi-host config; SURVEY.md §2c).
+    s = catalog.lookup("v5p-32")
+    assert s.chips == 16 and s.hosts == 4 and s.topology == "2x2x4"
+    assert s.multi_host and s.ici_dims == (2, 2, 4)
+
+
+def test_aliases():
+    assert catalog.lookup("v5litepod-8") is catalog.lookup("tpu-v5e-8")
+    assert catalog.lookup("V5E-8") is catalog.lookup("tpu-v5e-8")
+    assert catalog.lookup("v5p/2x2x4") is catalog.lookup("v5p-32")
+
+
+def test_accelerator_topology_resolution():
+    r = reqs((wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v5e"]),
+             (wk.TPU_TOPOLOGY_LABEL, kv1.IN, ["4x8"]))
+    s = catalog.resolve(r)
+    assert s.chips == 32 and s.hosts == 4
+
+
+def test_chip_count_resource_request():
+    r = reqs((wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v6e"]))
+    s = catalog.resolve(r, resources={wk.TPU_RESOURCE_NAME: "5"})
+    assert s.generation == "v6e" and s.chips == 8  # smallest fitting
+
+
+def test_unknown_shape_raises():
+    r = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["Standard_NC12s_v3"]))
+    with pytest.raises(catalog.UnknownShapeError):
+        catalog.resolve(r)
+
+
+def test_labels_act_as_requirements():
+    r = reqs(labels={wk.INSTANCE_TYPE_LABEL: "tpu-v4-32"})
+    s = catalog.resolve(r)
+    assert s.generation == "v4" and s.chips == 16 and s.hosts == 4
+
+
+def test_node_labels_and_capacity():
+    s = catalog.lookup("tpu-v5e-16")
+    labels = s.node_labels(slice_id="pool-abc")
+    assert labels[wk.GKE_TPU_TOPOLOGY_LABEL] == "4x4"
+    assert labels[wk.TPU_HOSTS_LABEL] == "2"
+    assert labels[wk.TPU_SLICE_ID_LABEL] == "pool-abc"
+    assert labels[wk.KAITO_MACHINE_TYPE_LABEL] == "tpu"
+    cap = s.per_host_capacity()
+    assert cap[wk.TPU_RESOURCE_NAME] == "8"
+
+
+def test_requirements_algebra():
+    r = reqs((wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v5e", "v5p"]),
+             (wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v5p"]))
+    assert r.get(wk.TPU_ACCELERATOR_LABEL).values() == ["v5p"]
+    assert r.compatible({wk.TPU_ACCELERATOR_LABEL: "v5p"})
+    assert not r.compatible({wk.TPU_ACCELERATOR_LABEL: "v5e"})
+    r2 = reqs((wk.ZONE_LABEL, kv1.NOT_IN, ["us-east1-a"]))
+    assert r2.compatible({})
+    assert not r2.compatible({wk.ZONE_LABEL: "us-east1-a"})
+
+
+def test_every_catalog_entry_consistent():
+    for s in catalog.CATALOG:
+        import math
+        assert math.prod(s.ici_dims) == s.chips, s.name
+        assert s.chips == s.hosts * s.chips_per_host or s.hosts == 1, s.name
+        assert catalog.lookup(s.name) is not None

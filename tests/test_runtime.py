@@ -1,0 +1,229 @@
+"""Controller runtime: store semantics, workqueue, controller loops."""
+
+import asyncio
+
+import pytest
+
+from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import ObjectMeta
+from gpu_provisioner_tpu.runtime import (
+    Controller, InMemoryClient, Manager, NotFoundError, ConflictError,
+    RateLimitingQueue, Request, Result, Singleton,
+)
+from gpu_provisioner_tpu.runtime.client import patch_retry
+from gpu_provisioner_tpu.runtime.store import ADDED, DELETED, MODIFIED
+
+from .conftest import async_test
+
+
+async def eventually(predicate, timeout=5.0, interval=0.01):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        r = predicate()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition never became true")
+        await asyncio.sleep(interval)
+
+
+def nc(name="a", finalizers=None):
+    return NodeClaim(metadata=ObjectMeta(name=name, finalizers=finalizers or []))
+
+
+# --- store -----------------------------------------------------------------
+
+@async_test
+async def test_store_crud_and_conflict():
+    c = InMemoryClient()
+    created = await c.create(nc())
+    assert created.metadata.uid and created.metadata.resource_version
+    stale = await c.get(NodeClaim, "a")
+    fresh = await c.get(NodeClaim, "a")
+    fresh.metadata.labels["x"] = "1"
+    await c.update(fresh)
+    stale.metadata.labels["y"] = "2"
+    with pytest.raises(ConflictError):
+        await c.update(stale)
+    with pytest.raises(NotFoundError):
+        await c.get(NodeClaim, "missing")
+
+
+@async_test
+async def test_generation_bumps_on_spec_only():
+    c = InMemoryClient()
+    await c.create(nc())
+    obj = await c.get(NodeClaim, "a")
+    obj.status.provider_id = "gce://p/z/i"
+    obj = await c.update_status(obj)
+    assert obj.metadata.generation == 1  # status write → no bump
+    obj.spec.termination_grace_period = "30s"
+    obj = await c.update(obj)
+    assert obj.metadata.generation == 2
+
+
+@async_test
+async def test_finalizer_semantics():
+    c = InMemoryClient()
+    await c.create(nc(finalizers=["karpenter.sh/termination"]))
+    await c.delete(NodeClaim, "a")
+    obj = await c.get(NodeClaim, "a")  # still there, deletion timestamp set
+    assert obj.metadata.deletion_timestamp is not None
+    obj.metadata.finalizers = []
+    await c.update(obj)
+    with pytest.raises(NotFoundError):
+        await c.get(NodeClaim, "a")
+
+
+@async_test
+async def test_watch_stream():
+    c = InMemoryClient()
+    w = c.watch(NodeClaim)
+    await c.create(nc())
+    obj = await c.get(NodeClaim, "a")
+    obj.metadata.labels["x"] = "1"
+    await c.update(obj)
+    await c.delete(NodeClaim, "a")
+    evs = [await asyncio.wait_for(w.__anext__(), 1) for _ in range(3)]
+    assert [e.type for e in evs] == [ADDED, MODIFIED, DELETED]
+    w.close()
+
+
+@async_test
+async def test_field_index():
+    c = InMemoryClient()
+    c.store.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
+    n = Node(metadata=ObjectMeta(name="n0"))
+    n.spec.provider_id = "gce://p/z/i0"
+    await c.create(n)
+    await c.create(Node(metadata=ObjectMeta(name="n1")))
+    hits = await c.list(Node, index=("spec.providerID", "gce://p/z/i0"))
+    assert [h.metadata.name for h in hits] == ["n0"]
+
+
+@async_test
+async def test_patch_retry_on_conflict():
+    c = InMemoryClient()
+    await c.create(nc())
+
+    calls = 0
+
+    def mutate(obj):
+        nonlocal calls
+        calls += 1
+        obj.metadata.labels["x"] = str(calls)
+
+    # sneak a concurrent write in by wrapping update to collide once
+    real_update = c.update
+    raced = False
+
+    async def racing_update(obj):
+        nonlocal raced
+        if not raced:
+            raced = True
+            other = await c.get(NodeClaim, "a")
+            other.metadata.annotations["r"] = "1"
+            await real_update(other)
+        return await real_update(obj)
+
+    c.update = racing_update
+    out = await patch_retry(c, NodeClaim, "a", mutate)
+    assert out.metadata.labels["x"] == "2" and calls == 2
+
+
+# --- workqueue -------------------------------------------------------------
+
+@async_test
+async def test_workqueue_dedup_and_processing_readd():
+    q = RateLimitingQueue()
+    await q.add("a")
+    await q.add("a")
+    assert len(q) == 1
+    item = await q.get()
+    await q.add("a")          # re-added while processing
+    assert len(q) == 0        # goes to dirty, not queue
+    await q.done(item)
+    assert len(q) == 1        # re-queued after done
+
+
+@async_test
+async def test_workqueue_backoff_and_forget():
+    q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+    await q.add_rate_limited("a")
+    assert q.num_requeues("a") == 1
+    item = await asyncio.wait_for(q.get(), 2)
+    assert item == "a"
+    await q.forget("a")
+    assert q.num_requeues("a") == 0
+
+
+@async_test
+async def test_workqueue_add_after_ordering():
+    q = RateLimitingQueue()
+    await q.add_after("slow", 0.05)
+    await q.add("fast")
+    assert await q.get() == "fast"
+    assert await asyncio.wait_for(q.get(), 2) == "slow"
+
+
+# --- controller/manager ----------------------------------------------------
+
+class CountingReconciler:
+    def __init__(self, fail_times=0):
+        self.seen: list[Request] = []
+        self.fail_times = fail_times
+
+    async def reconcile(self, req: Request) -> Result:
+        self.seen.append(req)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("boom")
+        return Result()
+
+
+@async_test
+async def test_controller_watch_drives_reconcile():
+    c = InMemoryClient()
+    r = CountingReconciler()
+    mgr = Manager(c).register(Controller("test", r).watches(NodeClaim))
+    await mgr.start()
+    try:
+        await c.create(nc("x"))
+        await eventually(lambda: any(s.name == "x" for s in r.seen))
+    finally:
+        await mgr.stop()
+
+
+@async_test
+async def test_controller_error_retries_with_backoff():
+    c = InMemoryClient()
+    r = CountingReconciler(fail_times=2)
+    ctrl = Controller("test", r).watches(NodeClaim)
+    ctrl.queue.base_delay = 0.01
+    mgr = Manager(c).register(ctrl)
+    await mgr.start()
+    try:
+        await c.create(nc("x"))
+        await eventually(lambda: len(r.seen) >= 3)  # 2 failures + 1 success
+    finally:
+        await mgr.stop()
+
+
+@async_test
+async def test_singleton_self_requeues():
+    runs = []
+
+    async def tick() -> float:
+        runs.append(1)
+        return 0.01
+
+    mgr = Manager(InMemoryClient()).register(
+        Controller("gc", Singleton(tick), max_concurrent=1).as_singleton())
+    await mgr.start()
+    try:
+        await eventually(lambda: len(runs) >= 3)
+    finally:
+        await mgr.stop()
