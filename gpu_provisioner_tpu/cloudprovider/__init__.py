@@ -1,0 +1,17 @@
+"""CloudProvider contract, error taxonomy, metrics decorator, TPU impl.
+
+Mirrors the layering of the reference: the Karpenter CloudProvider interface
+(vendor/sigs.k8s.io/karpenter/pkg/cloudprovider/types.go:72-100) is implemented
+by a thin shim (pkg/cloudprovider/cloudprovider.go) that delegates to the
+instance provider, and every call is wrapped in a Prometheus metrics decorator
+(vendor/.../cloudprovider/metrics/cloudprovider.go:95-190).
+"""
+
+from .errors import (  # noqa: F401
+    CloudProviderError, CreateError, InsufficientCapacityError,
+    NodeClaimNotFoundError, NodeClassNotReadyError, ignore_nodeclaim_not_found,
+    is_nodeclaim_not_found,
+)
+from .metrics import MetricsDecorator  # noqa: F401
+from .types import CloudProvider, RepairPolicy  # noqa: F401
+from .tpu import TPUCloudProvider  # noqa: F401

@@ -1,0 +1,9 @@
+"""Re-export of the cloud error taxonomy (lives top-level in
+``gpu_provisioner_tpu.errors`` to keep providers ↔ cloudprovider import-cycle
+free)."""
+
+from ..errors import (  # noqa: F401
+    CloudProviderError, CreateError, InsufficientCapacityError,
+    NodeClaimNotFoundError, NodeClassNotReadyError, ignore_nodeclaim_not_found,
+    is_nodeclaim_not_found,
+)

@@ -1,0 +1,76 @@
+"""Prometheus metrics decorator around any CloudProvider.
+
+The analog of vendor/sigs.k8s.io/karpenter/pkg/cloudprovider/metrics/
+cloudprovider.go:49-80,95-190 — method duration histogram + error counter
+labeled by (controller, method, provider, error type), applied at operator
+assembly time (cmd/controller/main.go:41 `metrics.Decorate`). Metric names
+kept identical so existing karpenter dashboards work unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from contextvars import ContextVar
+
+from prometheus_client import REGISTRY, Counter, Histogram
+
+# The reference stamps the calling controller into the context
+# (injection.WithControllerName); a ContextVar is the asyncio equivalent.
+current_controller: ContextVar[str] = ContextVar("controller", default="unknown")
+
+
+def _get_or_create(cls, name, doc, labelnames, **kw):
+    try:
+        return cls(name, doc, labelnames, **kw)
+    except ValueError:  # already registered (test re-imports)
+        return REGISTRY._names_to_collectors[name]
+
+
+METHOD_DURATION = _get_or_create(
+    Histogram, "karpenter_cloudprovider_duration_seconds",
+    "Duration of cloud provider method calls.",
+    ["controller", "method", "provider"])
+
+METHOD_ERRORS = _get_or_create(
+    Counter, "karpenter_cloudprovider_errors_total",
+    "Total number of cloud provider method errors.",
+    ["controller", "method", "provider", "error"])
+
+_DECORATED = ("create", "get", "list", "delete", "get_instance_types", "is_drifted")
+
+
+class MetricsDecorator:
+    """Wraps a CloudProvider; passthrough for non-IO methods."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def name(self) -> str:
+        return self.inner.name()
+
+    def repair_policies(self):
+        return self.inner.repair_policies()
+
+    def get_supported_node_classes(self):
+        return self.inner.get_supported_node_classes()
+
+    def __getattr__(self, method: str):
+        fn = getattr(self.inner, method)
+        if method not in _DECORATED:
+            return fn
+
+        async def wrapped(*args, **kwargs):
+            controller = current_controller.get()
+            provider = self.inner.name()
+            start = time.monotonic()
+            try:
+                return await fn(*args, **kwargs)
+            except Exception as e:
+                METHOD_ERRORS.labels(controller, method, provider,
+                                     type(e).__name__).inc()
+                raise
+            finally:
+                METHOD_DURATION.labels(controller, method, provider).observe(
+                    time.monotonic() - start)
+
+        return wrapped

@@ -1,0 +1,56 @@
+"""The CloudProvider interface the controllers program against.
+
+Method set mirrors vendor/sigs.k8s.io/karpenter/pkg/cloudprovider/types.go:72-100
+(Create/Delete/Get/List/GetInstanceTypes/IsDrifted/RepairPolicies/Name/
+GetSupportedNodeClasses). RepairPolicy drives the node auto-repair controller
+(reference: pkg/cloudprovider/cloudprovider.go:103-116 tolerates NodeReady
+False/Unknown for 10 minutes before force-replacing the node).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol
+
+from ..apis.karpenter import NodeClaim
+
+
+@dataclass(frozen=True)
+class RepairPolicy:
+    condition_type: str          # Node condition to watch, e.g. "Ready"
+    condition_status: str        # unhealthy value, e.g. "False"/"Unknown"
+    toleration_duration: float   # seconds before force-repair
+
+
+@dataclass(frozen=True)
+class InstanceTypeInfo:
+    """Catalog row surfaced through GetInstanceTypes. The reference returns an
+    empty list (cloudprovider.go:99-101, 'no catalog!'); the TPU build exposes
+    its real catalog so schedulers/tools can introspect shapes."""
+
+    name: str
+    generation: str
+    topology: str
+    chips: int
+    hosts: int
+    capacity: dict[str, str]
+
+
+class CloudProvider(Protocol):
+    def name(self) -> str: ...
+
+    async def create(self, nodeclaim: NodeClaim) -> NodeClaim: ...
+
+    async def get(self, provider_id: str) -> NodeClaim: ...
+
+    async def list(self) -> list[NodeClaim]: ...
+
+    async def delete(self, nodeclaim: NodeClaim) -> None: ...
+
+    async def get_instance_types(self) -> list[InstanceTypeInfo]: ...
+
+    async def is_drifted(self, nodeclaim: NodeClaim) -> str: ...
+
+    def repair_policies(self) -> list[RepairPolicy]: ...
+
+    def get_supported_node_classes(self) -> list[type]: ...

@@ -1,0 +1,53 @@
+"""Typed cloud-provider error taxonomy (top-level to stay import-cycle-free;
+re-exported via ``cloudprovider.errors``).
+
+Re-creates the error contract the controllers branch on (reference:
+vendor/sigs.k8s.io/karpenter/pkg/cloudprovider/errors.go): NodeClaimNotFound
+drives GC and termination short-circuits; InsufficientCapacity and
+NodeClassNotReady make the launch reconciler delete the NodeClaim instead of
+retrying (launch.go:84-109); CreateError carries a condition reason.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class NodeClaimNotFoundError(CloudProviderError):
+    """The instance backing a NodeClaim no longer exists in the cloud."""
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """The requested shape cannot be fulfilled (stockout, quota).
+
+    TPU note: Cloud TPU stockouts surface as RESOURCE_EXHAUSTED on node-pool
+    create or a SUSPENDED/FAILED queued resource; both map here so the launch
+    path can terminate the NodeClaim and let KAITO retry with a different
+    shape.
+    """
+
+
+class NodeClassNotReadyError(CloudProviderError):
+    """The referenced NodeClass is not ready (bad config, missing perms)."""
+
+
+class CreateError(CloudProviderError):
+    """Create failed in a way that should surface as a Launched=False reason."""
+
+    def __init__(self, message: str, reason: str = "LaunchFailed"):
+        super().__init__(message)
+        self.reason = reason
+
+
+def is_nodeclaim_not_found(err: Optional[BaseException]) -> bool:
+    return isinstance(err, NodeClaimNotFoundError)
+
+
+def ignore_nodeclaim_not_found(err: Optional[BaseException]) -> None:
+    """Re-raise anything that isn't a NodeClaimNotFoundError."""
+    if err is not None and not is_nodeclaim_not_found(err):
+        raise err

@@ -1,0 +1,16 @@
+"""Fault-injecting fakes: the multi-node-without-a-cluster answer.
+
+The reference tests its distributed behavior entirely through programmable
+fakes — mock ARM clients with scriptable LRO pollers and a hand-rolled k8s
+client that fabricates Ready nodes (pkg/fake/, SURVEY.md §4.2). Here the
+in-memory store already plays the apiserver, so the fakes simulate the
+**cloud**: node pools that become RUNNING after a latency, kubelet-joins that
+materialize Node objects per host, queued resources that drain on a schedule,
+and N-times error injection on any method (fake/types.go:82 BeginError
+analog).
+"""
+
+from .cloud import (  # noqa: F401
+    FakeCloud, FakeNodePoolsAPI, FakeQueuedResourcesAPI, TimedOperation,
+)
+from .builders import make_nodeclaim, make_node  # noqa: F401

@@ -1,0 +1,50 @@
+"""Object builders for tests and simulations (pkg/fake/nodeclaim.go analog:
+GetNodeClaimObj pre-labels kaito.sh/workspace + nodepool kaito)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis import karpenter as kv1
+from ..apis.core import Node, NodeSpec
+from ..apis.meta import Condition, ObjectMeta
+from ..apis.serde import now
+
+
+def make_nodeclaim(name: str = "ws0", shape: str = "tpu-v5e-8",
+                   workspace: str = "ws", storage: str = "",
+                   labels: Optional[dict[str, str]] = None,
+                   annotations: Optional[dict[str, str]] = None) -> kv1.NodeClaim:
+    meta_labels = {
+        wk.KAITO_WORKSPACE_LABEL: workspace,
+        wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,
+        **(labels or {}),
+    }
+    requests = {wk.TPU_RESOURCE_NAME: "1"}
+    if storage:
+        requests["storage"] = storage
+    return kv1.NodeClaim(
+        metadata=ObjectMeta(name=name, labels=meta_labels,
+                            annotations=annotations or {}),
+        spec=kv1.NodeClaimSpec(
+            requirements=[kv1.NodeSelectorRequirement(
+                key=wk.INSTANCE_TYPE_LABEL, operator=kv1.IN, values=[shape])],
+            resources=kv1.ResourceRequirements(requests=requests),
+            node_class_ref=kv1.NodeClassRef(group="kaito.sh", kind="KaitoNodeClass",
+                                            name="default"),
+        ),
+    )
+
+
+def make_node(name: str, provider_id: str = "", pool: str = "",
+              ready: bool = True, labels: Optional[dict[str, str]] = None) -> Node:
+    n = Node(metadata=ObjectMeta(name=name, labels=labels or {}),
+             spec=NodeSpec(provider_id=provider_id))
+    if pool:
+        n.metadata.labels.setdefault(wk.GKE_NODEPOOL_LABEL, pool)
+    n.status.conditions.append(Condition(
+        type="Ready", status="True" if ready else "False",
+        reason="KubeletReady" if ready else "KubeletNotReady",
+        last_transition_time=now()))
+    return n

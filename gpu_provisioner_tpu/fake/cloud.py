@@ -1,0 +1,258 @@
+"""Fake GKE/Cloud-TPU backend with latency and fault injection.
+
+Plays the role the scripted LRO pollers + MockedLRO fakes play in the
+reference (pkg/fake/types.go:26-173, pollingHandler.go): deterministic,
+programmable cloud behavior — but as one coherent simulator: a created node
+pool transitions PROVISIONING→RUNNING after ``create_latency``, then each
+host's kubelet "joins" by materializing a Node object (unready → Ready after
+``node_ready_delay``) with GKE + tpu.kaito.sh labels, the way
+fake/k8sClient.go:210-241 fabricates Ready nodes with agentpool labels and
+VMSS providerIDs. Error injection mirrors AtomicError/MaxCalls
+(fake/atomic.go): ``fail(method, error, times)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..catalog import lookup as catalog_lookup
+from ..providers.gcp import (
+    APIError, NodePool, QueuedResource,
+    NP_PROVISIONING, NP_RUNNING, NP_STOPPING,
+    QR_ACCEPTED, QR_ACTIVE, QR_CREATING, QR_WAITING,
+)
+from ..providers.instance import instance_name, provider_id
+from ..runtime.client import Client, NotFoundError
+from .builders import make_node
+
+
+class TimedOperation:
+    """LRO that completes ``latency`` seconds after creation; optionally runs
+    ``on_done`` (async) once, then returns ``result`` or raises ``error``."""
+
+    def __init__(self, latency: float = 0.0, result=None,
+                 error: Optional[Exception] = None, on_done=None):
+        self._deadline = time.monotonic() + latency
+        self._result = result
+        self._error = error
+        self._on_done = on_done
+        self._fired = False
+
+    async def done(self) -> bool:
+        if time.monotonic() < self._deadline:
+            return False
+        if not self._fired:
+            self._fired = True
+            if self._on_done is not None:
+                await self._on_done()
+        return True
+
+    async def result(self):
+        while not await self.done():
+            await asyncio.sleep(0.001)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _FaultInjector:
+    def __init__(self):
+        self._faults: dict[str, list[tuple[Exception, int]]] = defaultdict(list)
+        self.calls: dict[str, int] = defaultdict(int)
+
+    def fail(self, method: str, error: Exception, times: int = 1) -> None:
+        self._faults[method].append((error, times))
+
+    def _check(self, method: str) -> None:
+        self.calls[method] += 1
+        faults = self._faults[method]
+        if faults:
+            error, times = faults[0]
+            if times <= 1:
+                faults.pop(0)
+            else:
+                faults[0] = (error, times - 1)
+            raise error
+
+
+class FakeNodePoolsAPI(_FaultInjector):
+    def __init__(self, cloud: "FakeCloud"):
+        super().__init__()
+        self.cloud = cloud
+        self.pools: dict[str, NodePool] = {}
+
+    async def begin_create(self, pool: NodePool):
+        self._check("begin_create")
+        if pool.name in self.pools and self.pools[pool.name].status == NP_PROVISIONING:
+            raise APIError(f"operation on {pool.name} already in progress", code=409)
+        stored = NodePool.from_dict(pool.to_dict())
+        stored.status = NP_PROVISIONING
+        self.pools[pool.name] = stored
+
+        async def finish():
+            if self.pools.get(pool.name) is stored:
+                stored.status = NP_RUNNING
+                await self.cloud.join_nodes(stored)
+
+        return TimedOperation(self.cloud.create_latency, result=stored, on_done=finish)
+
+    async def get(self, name: str) -> NodePool:
+        self._check("get")
+        pool = self.pools.get(name)
+        if pool is None:
+            raise APIError(f"nodepool {name} not found", code=404)
+        return NodePool.from_dict(pool.to_dict())
+
+    async def begin_delete(self, name: str):
+        self._check("begin_delete")
+        pool = self.pools.get(name)
+        if pool is None:
+            raise APIError(f"nodepool {name} not found", code=404)
+        pool.status = NP_STOPPING
+
+        async def finish():
+            self.pools.pop(name, None)
+            if not self.cloud.leave_orphan_nodes:
+                await self.cloud.remove_nodes(name)
+
+        return TimedOperation(self.cloud.delete_latency, on_done=finish)
+
+    async def list(self) -> list[NodePool]:
+        self._check("list")
+        return [NodePool.from_dict(p.to_dict()) for p in self.pools.values()]
+
+
+class FakeQueuedResourcesAPI(_FaultInjector):
+    """Queued resources drain ACCEPTED→WAITING→CREATING→ACTIVE, one state per
+    ``advance()`` or automatically every ``cloud.qr_step_latency`` seconds."""
+
+    _LADDER = [QR_ACCEPTED, QR_WAITING, QR_CREATING, QR_ACTIVE]
+
+    def __init__(self, cloud: "FakeCloud"):
+        super().__init__()
+        self.cloud = cloud
+        self.resources: dict[str, QueuedResource] = {}
+        self._created_at: dict[str, float] = {}
+
+    async def create(self, qr: QueuedResource) -> QueuedResource:
+        self._check("create")
+        if qr.name in self.resources:
+            raise APIError(f"queued resource {qr.name} exists", code=409)
+        self.resources[qr.name] = qr
+        self._created_at[qr.name] = time.monotonic()
+        return qr
+
+    async def get(self, name: str) -> QueuedResource:
+        self._check("get")
+        qr = self.resources.get(name)
+        if qr is None:
+            raise APIError(f"queued resource {name} not found", code=404)
+        self._auto_advance(qr)
+        return qr
+
+    async def delete(self, name: str) -> None:
+        self._check("delete")
+        if self.resources.pop(name, None) is None:
+            raise APIError(f"queued resource {name} not found", code=404)
+        self._created_at.pop(name, None)
+
+    async def list(self) -> list[QueuedResource]:
+        self._check("list")
+        for qr in self.resources.values():
+            self._auto_advance(qr)
+        return list(self.resources.values())
+
+    def _auto_advance(self, qr: QueuedResource) -> None:
+        if qr.state not in self._LADDER:
+            return  # SUSPENDED/FAILED are terminal until test flips them
+        elapsed = time.monotonic() - self._created_at.get(qr.name, 0)
+        steps = int(elapsed / self.cloud.qr_step_latency) if self.cloud.qr_step_latency else len(self._LADDER)
+        idx = min(self._LADDER.index(QR_ACCEPTED) + steps, len(self._LADDER) - 1)
+        current = self._LADDER.index(qr.state)
+        qr.state = self._LADDER[max(idx, current)]
+
+    def suspend(self, name: str, message: str = "stockout") -> None:
+        qr = self.resources[name]
+        qr.state = "SUSPENDED"
+        qr.state_message = message
+
+
+class FakeCloud:
+    """The coherent simulator tying the fake APIs to the kube store."""
+
+    def __init__(self, kube: Client, project: str = "test-project",
+                 zone: str = "us-central2-b", cluster: str = "kaito",
+                 create_latency: float = 0.05, delete_latency: float = 0.02,
+                 node_join_delay: float = 0.0, node_ready_delay: float = 0.0,
+                 qr_step_latency: float = 0.02,
+                 leave_orphan_nodes: bool = False):
+        self.kube = kube
+        self.project, self.zone, self.cluster = project, zone, cluster
+        self.create_latency = create_latency
+        self.delete_latency = delete_latency
+        self.node_join_delay = node_join_delay
+        self.node_ready_delay = node_ready_delay
+        self.qr_step_latency = qr_step_latency
+        self.leave_orphan_nodes = leave_orphan_nodes
+        self.nodepools = FakeNodePoolsAPI(self)
+        self.queuedresources = FakeQueuedResourcesAPI(self)
+        self._join_tasks: list[asyncio.Task] = []
+
+    async def join_nodes(self, pool: NodePool) -> None:
+        """Simulate each host's kubelet joining: Node objects appear with
+        providerIDs + GKE/topology labels, unready first, Ready after delay."""
+        shape = catalog_lookup(pool.config.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
+        capacity = (shape.per_host_capacity() if shape
+                    else {wk.TPU_RESOURCE_NAME: "1", "cpu": "96", "memory": "448Gi"})
+        for worker in range(pool.initial_node_count):
+            name = instance_name(self.cluster, pool.name, worker)
+            labels = dict(pool.config.labels)
+            labels[wk.GKE_NODEPOOL_LABEL] = pool.name
+            labels[wk.TPU_WORKER_INDEX_LABEL] = str(worker)
+            labels[wk.HOSTNAME_LABEL] = name
+            node = make_node(name, provider_id=provider_id(self.project, self.zone, name),
+                             pool=pool.name, ready=self.node_ready_delay <= 0,
+                             labels=labels)
+            node.status.capacity = dict(capacity)
+            node.status.allocatable = dict(capacity)
+            if self.node_join_delay > 0:
+                self._join_tasks.append(asyncio.create_task(
+                    self._delayed_join(node, self.node_join_delay * (worker + 1))))
+            else:
+                await self._join(node)
+
+    async def _delayed_join(self, node: Node, delay: float) -> None:
+        await asyncio.sleep(delay)
+        await self._join(node)
+
+    async def _join(self, node: Node) -> None:
+        try:
+            await self.kube.create(node)
+        except Exception:
+            return  # already joined (crash-restart create retry)
+        if self.node_ready_delay > 0:
+            self._join_tasks.append(asyncio.create_task(self._become_ready(node)))
+
+    async def _become_ready(self, node: Node) -> None:
+        await asyncio.sleep(self.node_ready_delay)
+        try:
+            fresh = await self.kube.get(Node, node.metadata.name)
+        except NotFoundError:
+            return
+        for c in fresh.status.conditions:
+            if c.type == "Ready":
+                c.status = "True"
+                c.reason = "KubeletReady"
+        await self.kube.update_status(fresh)
+
+    async def remove_nodes(self, pool_name: str) -> None:
+        for node in await self.kube.list(Node, labels={wk.GKE_NODEPOOL_LABEL: pool_name}):
+            try:
+                await self.kube.delete(Node, node.metadata.name)
+            except NotFoundError:
+                pass

@@ -1,0 +1,18 @@
+"""Instance provider + GCP client layer (L1/L2 of the layer map, SURVEY.md §1).
+
+``gcp`` holds the cloud resource models, the narrow API seams and LRO helpers
+(the TPU analog of pkg/providers/instance/azure_client.go + armutils.go);
+``rest`` the real HTTP implementations; ``instance`` the NodeClaim ⇄ node-pool
+mapping (the TPU analog of pkg/providers/instance/instance.go).
+"""
+
+from .gcp import (  # noqa: F401
+    NodePool, NodePoolConfig, NodePoolsAPI, Operation, PlacementPolicy,
+    QueuedResource, QueuedResourcesAPI, poll_until_done,
+    NP_PROVISIONING, NP_RUNNING, NP_STOPPING, NP_ERROR, NP_RECONCILING,
+    QR_ACCEPTED, QR_ACTIVE, QR_CREATING, QR_FAILED, QR_SUSPENDED, QR_WAITING,
+)
+from .instance import (  # noqa: F401
+    Instance, InstanceProvider, STATE_CREATING, STATE_DELETING, STATE_FAILED,
+    STATE_SUCCEEDED, nodepool_name_valid, parse_nodepool_from_provider_id,
+)

@@ -1,0 +1,167 @@
+"""GCP resource models, narrow API seams, and LRO helpers.
+
+Design lifted from what makes the reference testable: a 4-method interface in
+front of the cloud SDK (azure_client.go:42-47 — BeginCreateOrUpdate / Get /
+BeginDelete / NewListPager) plus thin poll-until-done CRUD helpers
+(armutils.go:28-101). Here there are two seams:
+
+- ``NodePoolsAPI``       GKE node pools (container.googleapis.com) — the
+                         direct analog of the AKS AgentPools API; used for all
+                         on-demand/spot slices.
+- ``QueuedResourcesAPI`` Cloud TPU queued resources (tpu.googleapis.com) — no
+                         Azure analog; adds a WAITING→PROVISIONING→ACTIVE
+                         state machine with stockout queueing, used for
+                         reserved/queued capacity (SURVEY.md §7 hard part 2).
+
+Models are hand-built dataclasses shaped like the REST payloads (camelCase via
+apis.serde), not SDK imports — no GCP SDK exists in this environment and the
+wire format is plain JSON anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from ..apis.serde import from_dict, to_dict
+
+# GKE node-pool status values (container/v1 NodePool.Status).
+NP_PROVISIONING = "PROVISIONING"
+NP_RUNNING = "RUNNING"
+NP_RECONCILING = "RECONCILING"
+NP_STOPPING = "STOPPING"
+NP_ERROR = "ERROR"
+
+# Cloud TPU queued-resource states (tpu/v2 QueuedResourceState).
+QR_ACCEPTED = "ACCEPTED"
+QR_WAITING = "WAITING_FOR_RESOURCES"
+QR_CREATING = "CREATING"
+QR_ACTIVE = "ACTIVE"
+QR_SUSPENDED = "SUSPENDED"
+QR_FAILED = "FAILED"
+
+
+@dataclass
+class PlacementPolicy:
+    type: str = "COMPACT"
+    tpu_topology: str = ""
+
+
+@dataclass
+class NodePoolConfig:
+    machine_type: str = ""
+    disk_size_gb: int = 0
+    labels: dict[str, str] = field(default_factory=dict)
+    taints: list[dict] = field(default_factory=list)
+    spot: bool = False
+    reservation: str = ""
+    image_type: str = ""  # e.g. "COS_CONTAINERD" (reference OSSKU analog)
+
+
+@dataclass
+class NodePool:
+    name: str = ""
+    config: NodePoolConfig = field(default_factory=NodePoolConfig)
+    initial_node_count: int = 1
+    placement_policy: Optional[PlacementPolicy] = None
+    status: str = ""
+    status_message: str = ""
+    # serialized via apis.serde (camelCase) when sent over REST
+
+    def to_dict(self) -> dict:
+        return to_dict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodePool":
+        return from_dict(cls, d)
+
+
+@dataclass
+class QueuedResource:
+    name: str = ""
+    accelerator_type: str = ""   # e.g. "v5p-32"
+    runtime_version: str = ""
+    state: str = QR_ACCEPTED
+    state_message: str = ""
+    node_pool: str = ""          # target node pool materialized when ACTIVE
+    reservation: str = ""
+    spot: bool = False
+
+
+class Operation(Protocol):
+    """A long-running operation (ARM poller / GCP Operation analog)."""
+
+    async def done(self) -> bool: ...
+    async def result(self): ...
+
+
+class CompletedOperation:
+    """An LRO that is already complete (or failed)."""
+
+    def __init__(self, value=None, error: Optional[Exception] = None):
+        self._value = value
+        self._error = error
+
+    async def done(self) -> bool:
+        return True
+
+    async def result(self):
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+async def poll_until_done(op: Operation, interval: float = 1.0,
+                          timeout: float = 1800.0, jitter: float = 0.1):
+    """Block until the LRO completes and return its result.
+
+    The analog of azcore's ``PollUntilDone`` the reference calls for both
+    create and delete (armutils.go:28-40). The reference accepts blocking a
+    reconcile worker for the full create; the lifecycle controller here does
+    the same for node pools (minutes) but NOT for queued resources (hours) —
+    those go through the async requeue path in the instance provider.
+    """
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not await op.done():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"LRO not done after {timeout}s")
+        await asyncio.sleep(interval * (1 + random.random() * jitter))
+    return await op.result()
+
+
+class NodePoolsAPI(Protocol):
+    """The 4-method seam in front of GKE node pools (azure_client.go:42-47)."""
+
+    async def begin_create(self, pool: NodePool) -> Operation: ...
+    async def get(self, name: str) -> NodePool: ...
+    async def begin_delete(self, name: str) -> Operation: ...
+    async def list(self) -> list[NodePool]: ...
+
+
+class QueuedResourcesAPI(Protocol):
+    async def create(self, qr: QueuedResource) -> QueuedResource: ...
+    async def get(self, name: str) -> QueuedResource: ...
+    async def delete(self, name: str) -> None: ...
+    async def list(self) -> list[QueuedResource]: ...
+
+
+class APIError(Exception):
+    """Cloud API error with an HTTP-ish status code for taxonomy mapping."""
+
+    def __init__(self, message: str, code: int = 500):
+        super().__init__(message)
+        self.code = code
+
+    @property
+    def not_found(self) -> bool:
+        return self.code == 404
+
+    @property
+    def conflict(self) -> bool:
+        return self.code == 409
+
+    @property
+    def exhausted(self) -> bool:
+        return self.code == 429

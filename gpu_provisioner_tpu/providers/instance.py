@@ -1,0 +1,429 @@
+"""Instance provider: NodeClaim ⇄ TPU node pool mapping (L2 of the layer map).
+
+The TPU re-design of pkg/providers/instance/instance.go. The reference maps
+one NodeClaim to an AKS agent pool with exactly one GPU VM (Count=1,
+instance.go:365); here one NodeClaim maps to a **slice**: a GKE TPU node pool
+whose node count equals the shape's host count, with ICI topology expressed
+via the pool's placement policy and surfaced as labels. Multi-host shapes
+(e.g. v5p-32 = 4 hosts) therefore materialize multiple Node objects from a
+single NodeClaim — the registration-wait generalizes the reference's
+"exactly one node else wait" invariant (instance.go:220-225) to "all hosts
+present with consistent worker indices" (SURVEY.md §7 hard part 1).
+
+Reserved/queued capacity goes through the Cloud TPU QueuedResource state
+machine instead of a blocking LRO: create() returns fast and raises a
+retryable error while the queue drains, so a reconcile worker is never parked
+for the hours a stockout can last (SURVEY.md §7 hard part 2 — deliberate
+departure from the reference's PollUntilDone-blocks-worker model).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import catalog as cat
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..apis.karpenter import NodeClaim
+from ..apis.serde import fmt_time, now, parse_time
+from ..errors import (
+    CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
+)
+from ..runtime.client import Client
+from ..scheduling import Requirements
+from .gcp import (
+    APIError, NodePool, NodePoolConfig, NodePoolsAPI, PlacementPolicy,
+    QueuedResource, QueuedResourcesAPI, poll_until_done,
+    NP_ERROR, NP_PROVISIONING, NP_RUNNING, NP_STOPPING,
+    QR_ACTIVE, QR_FAILED, QR_SUSPENDED,
+)
+
+log = logging.getLogger("providers.instance")
+
+# Cloud-neutral instance states (reference types.go uses AKS provisioning
+# states Creating/Succeeded/Deleting/Failed; GKE statuses map onto them).
+STATE_CREATING = "Creating"
+STATE_SUCCEEDED = "Succeeded"
+STATE_DELETING = "Deleting"
+STATE_FAILED = "Failed"
+
+_NP_STATE_MAP = {
+    NP_PROVISIONING: STATE_CREATING,
+    NP_RUNNING: STATE_SUCCEEDED,
+    "RECONCILING": STATE_SUCCEEDED,
+    NP_STOPPING: STATE_DELETING,
+    NP_ERROR: STATE_FAILED,
+}
+
+# GKE node-pool naming constraint (RFC1035-ish, 40 chars) — the analog of the
+# reference's agent-pool gate `^[a-z][a-z0-9]{0,11}$` (instance.go:50,81-84).
+NODEPOOL_NAME_RE = re.compile(r"^[a-z](?:[-a-z0-9]{0,38}[a-z0-9])?$")
+
+# Annotation selecting the queued-resource path for a NodeClaim.
+PROVISIONING_MODE_ANNOTATION = "tpu.kaito.sh/provisioning-mode"
+MODE_QUEUED = "queued"
+
+_PROVIDER_ID_RE = re.compile(r"^gce://(?P<project>[^/]+)/(?P<zone>[^/]+)/(?P<instance>.+)$")
+
+
+def nodepool_name_valid(name: str) -> bool:
+    return bool(NODEPOOL_NAME_RE.match(name))
+
+
+def instance_name(cluster: str, pool: str, worker: int) -> str:
+    """GKE instance naming convention: gke-<cluster>-<pool>-<suffix>."""
+    return f"gke-{cluster}-{pool}-w{worker}"
+
+
+def provider_id(project: str, zone: str, instance: str) -> str:
+    return f"gce://{project}/{zone}/{instance}"
+
+
+def parse_nodepool_from_provider_id(pid: str, cluster: str) -> Optional[str]:
+    """Extract the node-pool name from a gce:// providerID.
+
+    Fallback only — nodes carry ``cloud.google.com/gke-nodepool`` which is
+    authoritative. String-parsing providerIDs is inherently fragile (the
+    reference does the same for VMSS IDs, utils.go:27-46, taking the 2nd
+    '-'-token); here we strip the known ``gke-<cluster>-`` prefix and the
+    ``-w<N>`` suffix instead of position-guessing.
+    """
+    m = _PROVIDER_ID_RE.match(pid or "")
+    if not m:
+        return None
+    inst = m.group("instance")
+    prefix = f"gke-{cluster}-"
+    if not inst.startswith(prefix):
+        return None
+    rest = inst[len(prefix):]
+    return re.sub(r"-w\d+$", "", rest) or None
+
+
+@dataclass
+class Instance:
+    """Cloud-neutral instance model (reference: types.go:19-29) extended with
+    slice fields (a TPU instance is a multi-host slice, not one VM)."""
+
+    name: str = ""
+    state: str = ""
+    id: str = ""                      # providerID of worker 0
+    image_id: str = ""
+    type: str = ""                    # catalog shape name, e.g. tpu-v5e-8
+    capacity_type: str = wk.CAPACITY_TYPE_ON_DEMAND
+    tags: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    # slice extension
+    topology: str = ""
+    hosts: int = 1
+    chips: int = 0
+    node_provider_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ProviderConfig:
+    project: str = "test-project"
+    zone: str = "us-central2-b"
+    cluster: str = "kaito"
+    # Node-appearance wait after pool create: reference does 30 × 1s + jitter
+    # (instance.go:126-131); multi-host slices get more room per host.
+    node_wait_attempts: int = 30
+    node_wait_interval: float = 1.0
+    node_wait_jitter: float = 0.1
+
+
+class InstanceProvider:
+    """Create/Get/List/Delete over the node-pool + queued-resource seams."""
+
+    def __init__(self, nodepools: NodePoolsAPI, kube: Client,
+                 config: Optional[ProviderConfig] = None,
+                 queued: Optional[QueuedResourcesAPI] = None):
+        self.nodepools = nodepools
+        self.queued = queued
+        self.kube = kube
+        self.cfg = config or ProviderConfig()
+
+    # ------------------------------------------------------------- create
+    async def create(self, nc: NodeClaim) -> Instance:
+        name = nc.metadata.name
+        if not nodepool_name_valid(name):
+            raise CreateError(
+                f"nodeclaim name {name!r} is not a valid node-pool name "
+                f"(must match {NODEPOOL_NAME_RE.pattern})", reason="InvalidName")
+
+        reqs = Requirements.from_nodeclaim(nc)
+        try:
+            shape = cat.resolve(reqs, nc.spec.resources.requests)
+        except (cat.UnknownShapeError, ValueError) as e:
+            # ValueError: malformed numeric requirement/request strings — same
+            # terminal fate as an unknown shape, never a retry loop.
+            raise CreateError(str(e), reason="UnresolvableShape") from e
+        capacity_type = self._capacity_type(reqs)
+
+        if self._queued_mode(nc, reqs):
+            await self._ensure_queued_resource(nc, shape, capacity_type)
+
+        pool = self._new_nodepool_object(nc, shape, capacity_type)
+        try:
+            op = await self.nodepools.begin_create(pool)
+            await poll_until_done(op)
+        except APIError as e:
+            if e.conflict:
+                # Crash-restart tolerance: a create from a previous incarnation
+                # is still in flight — fall through to the node wait
+                # (reference: instance.go:106-110).
+                log.info("nodepool %s create already in progress, continuing", name)
+            elif e.exhausted:
+                raise InsufficientCapacityError(
+                    f"nodepool {name} ({shape.slice_name}): {e}") from e
+            else:
+                raise CreateError(f"creating nodepool {name}: {e}") from e
+
+        nodes = await self._wait_for_nodes(name, shape.hosts)
+        created = await self.nodepools.get(name)
+        return self._to_instance(created, shape=shape, nodes=nodes)
+
+    def _queued_mode(self, nc: NodeClaim, reqs: Requirements) -> bool:
+        if self.queued is None:
+            return False
+        mode = nc.metadata.annotations.get(PROVISIONING_MODE_ANNOTATION, "")
+        capacity = reqs.get(wk.CAPACITY_TYPE_LABEL).values()
+        return mode == MODE_QUEUED or wk.CAPACITY_TYPE_RESERVED in capacity
+
+    async def _ensure_queued_resource(self, nc: NodeClaim, shape: cat.SliceShape,
+                                      capacity_type: str) -> None:
+        """Drive the QueuedResource state machine without blocking.
+
+        ACTIVE → proceed to node-pool create. WAITING/CREATING/ACCEPTED →
+        raise a retryable CreateError so the launch reconciler requeues with
+        backoff (async analog of PollUntilDone). SUSPENDED/FAILED →
+        InsufficientCapacity, which terminates the NodeClaim (launch.go:84-95).
+        """
+        name = nc.metadata.name
+        try:
+            qr = await self.queued.get(name)
+        except APIError as e:
+            if not e.not_found:
+                raise CreateError(f"getting queued resource {name}: {e}") from e
+            qr = await self.queued.create(QueuedResource(
+                name=name, accelerator_type=shape.slice_name, node_pool=name,
+                spot=capacity_type == wk.CAPACITY_TYPE_SPOT))
+        if qr.state in (QR_SUSPENDED, QR_FAILED):
+            raise InsufficientCapacityError(
+                f"queued resource {name} {qr.state}: {qr.state_message}")
+        if qr.state != QR_ACTIVE:
+            raise CreateError(
+                f"queued resource {name} is {qr.state}; requeueing",
+                reason="QueuedProvisioning")
+
+    def _capacity_type(self, reqs: Requirements) -> str:
+        vals = reqs.get(wk.CAPACITY_TYPE_LABEL).values()
+        return vals[0] if vals else wk.CAPACITY_TYPE_ON_DEMAND
+
+    def _new_nodepool_object(self, nc: NodeClaim, shape: cat.SliceShape,
+                             capacity_type: str) -> NodePool:
+        """Build the desired NodePool (analog: newAgentPoolObject,
+        instance.go:321-369)."""
+        labels = {
+            wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,           # :330
+            wk.KAITO_MACHINE_TYPE_LABEL: "tpu",                  # :335-339
+            wk.KAITO_CREATION_TIMESTAMP_LABEL: ts_label(now()),  # :340-342
+            **shape.node_labels(slice_id=nc.metadata.name),
+        }
+        for key in (wk.KAITO_WORKSPACE_LABEL, wk.KAITO_RAGENGINE_LABEL,
+                    wk.TPU_SLICE_GROUP_LABEL):
+            if key in nc.metadata.labels:
+                labels[key] = nc.metadata.labels[key]
+
+        disk = 0
+        storage = nc.spec.resources.requests.get("storage", "")
+        if storage:
+            try:
+                disk = parse_gi(storage)  # :344-353 storage request → disk size
+            except ValueError as e:
+                raise CreateError(f"invalid storage request {storage!r}: {e}",
+                                  reason="InvalidStorageRequest") from e
+
+        image = image_family_to_image_type(
+            nc.metadata.annotations.get(wk.KAITO_NODE_IMAGE_FAMILY_ANNOTATION, ""))
+
+        taints = [{"key": wk.TPU_TAINT, "value": "present", "effect": "NO_SCHEDULE"}]
+        return NodePool(
+            name=nc.metadata.name,
+            config=NodePoolConfig(
+                machine_type=shape.machine_type,
+                disk_size_gb=disk,
+                labels=labels,
+                taints=taints,
+                spot=capacity_type == wk.CAPACITY_TYPE_SPOT,
+                image_type=image,
+            ),
+            initial_node_count=shape.hosts,  # generalizes Count=1 (:365)
+            placement_policy=PlacementPolicy(tpu_topology=shape.topology),
+        )
+
+    async def _wait_for_nodes(self, pool: str, hosts: int) -> list[Node]:
+        """Wait for all hosts' Node objects to exist with providerIDs
+        (generalizes instance.go:124-149; correlation by the GKE node-pool
+        label, the analog of getNodesByName's agentpool labels :371-385)."""
+        attempts = self.cfg.node_wait_attempts + 5 * (hosts - 1)
+        ready: list[Node] = []
+        for _ in range(attempts):
+            nodes = await self._nodes_of_pool(pool)
+            ready = [n for n in nodes if n.spec.provider_id]
+            if len(ready) >= hosts:
+                return sorted(ready, key=worker_index)
+            await asyncio.sleep(self.cfg.node_wait_interval
+                                * (1 + random.random() * self.cfg.node_wait_jitter))
+        raise CreateError(
+            f"nodepool {pool}: only {len(ready)}/{hosts} nodes appeared with "
+            "providerIDs before timeout", reason="NodesNotReady")
+
+    async def _nodes_of_pool(self, pool: str) -> list[Node]:
+        return await self.kube.list(Node, labels={wk.GKE_NODEPOOL_LABEL: pool})
+
+    # ---------------------------------------------------------- get/list
+    async def get(self, pid: str) -> Instance:
+        pool_name = await self._pool_name_for(pid)
+        if pool_name is None:
+            raise NodeClaimNotFoundError(f"no node pool for providerID {pid}")
+        try:
+            pool = await self.nodepools.get(pool_name)
+        except APIError as e:
+            if e.not_found:
+                raise NodeClaimNotFoundError(f"nodepool {pool_name} not found") from e
+            raise
+        return await self._from_pool(pool)
+
+    async def _pool_name_for(self, pid: str) -> Optional[str]:
+        nodes = await self.kube.list(Node, index=("spec.providerID", pid)) \
+            if has_index(self.kube) else []
+        if not nodes:
+            nodes = [n for n in await self.kube.list(Node) if n.spec.provider_id == pid]
+        if nodes:
+            pool = nodes[0].metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
+            if pool:
+                return pool
+        return parse_nodepool_from_provider_id(pid, self.cfg.cluster)
+
+    async def list(self) -> list[Instance]:
+        """All kaito-owned, nodeclaim-created instances (fromAPListToInstances
+        :289-319 + ownership gates :387-413)."""
+        pools = await self.nodepools.list()
+        out = []
+        for p in pools:
+            if not pool_owned_by_kaito(p) or not pool_created_from_nodeclaim(p):
+                continue
+            out.append(await self._from_pool(p))
+        return out
+
+    async def _from_pool(self, pool: NodePool) -> Instance:
+        nodes = await self._nodes_of_pool(pool.name)
+        shape = cat.lookup(pool.config.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
+        return self._to_instance(pool, shape=shape, nodes=nodes)
+
+    def _to_instance(self, pool: NodePool, shape: Optional[cat.SliceShape],
+                     nodes: list[Node]) -> Instance:
+        nodes = sorted([n for n in nodes if n.spec.provider_id], key=worker_index)
+        pids = [n.spec.provider_id for n in nodes]
+        return Instance(
+            name=pool.name,
+            state=_NP_STATE_MAP.get(pool.status, STATE_CREATING),
+            id=pids[0] if pids else "",
+            image_id=pool.config.image_type,
+            type=shape.name if shape else pool.config.machine_type,
+            capacity_type=(wk.CAPACITY_TYPE_SPOT if pool.config.spot
+                           else wk.CAPACITY_TYPE_ON_DEMAND),
+            labels=dict(pool.config.labels),
+            topology=shape.topology if shape else "",
+            hosts=pool.initial_node_count,
+            chips=shape.chips if shape else 0,
+            node_provider_ids=pids,
+        )
+
+    # ------------------------------------------------------------- delete
+    async def delete(self, name: str) -> None:
+        """Get-first delete: skip if already Deleting, map NotFound →
+        NodeClaimNotFoundError (armutils.go:42-76)."""
+        try:
+            pool = await self.nodepools.get(name)
+        except APIError as e:
+            if e.not_found:
+                raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
+            raise
+        if pool.status == NP_STOPPING:
+            log.info("nodepool %s already deleting, skipping", name)
+            return
+        if self.queued is not None:
+            try:
+                await self.queued.delete(name)
+            except APIError as e:
+                if not e.not_found:
+                    raise
+        try:
+            op = await self.nodepools.begin_delete(name)
+            await poll_until_done(op)
+        except APIError as e:
+            if e.not_found:
+                raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
+            raise
+
+
+# --------------------------------------------------------------- helpers
+
+def ts_label(t) -> str:
+    """RFC3339 isn't label-safe; use the reference's datetime label trick
+    (instance.go:43-45 uses a custom layout) — here compact YYYYMMDDTHHMMSSZ."""
+    return fmt_time(t).replace("-", "").replace(":", "")
+
+
+def parse_ts_label(s: str):
+    try:
+        return parse_time(f"{s[0:4]}-{s[4:6]}-{s[6:11]}:{s[11:13]}:{s[13:]}")
+    except (ValueError, IndexError):
+        return None
+
+
+def parse_gi(q: str) -> int:
+    """Parse a Kubernetes storage Quantity to whole GiB. Raises ValueError on
+    unparseable input (callers map it into the CreateError taxonomy)."""
+    q = q.strip()
+    for suffix, mult in (("Gi", 1), ("G", 1), ("Ti", 1024), ("T", 1000), ("Mi", 0), ("M", 0)):
+        if q.endswith(suffix):
+            val = float(q[: -len(suffix)])
+            return int(val * mult) if mult else max(1, int(val / 1024))
+    return int(float(q) / (1024 ** 3)) if q else 0
+
+
+def image_family_to_image_type(family: str) -> str:
+    """kaito.sh/node-image-family annotation → GKE image type (the analog of
+    imageFamilyToOSSKU, instance.go:431, Ubuntu/AzureLinux → OSSKU)."""
+    return {
+        "": "",
+        "cos": "COS_CONTAINERD",
+        "ubuntu": "UBUNTU_CONTAINERD",
+    }.get(family.lower(), "")
+
+
+def pool_owned_by_kaito(pool: NodePool) -> bool:
+    return pool.config.labels.get(wk.NODEPOOL_LABEL) == wk.KAITO_NODEPOOL_NAME
+
+
+def pool_created_from_nodeclaim(pool: NodePool) -> bool:
+    return wk.KAITO_CREATION_TIMESTAMP_LABEL in pool.config.labels
+
+
+def worker_index(node: Node) -> int:
+    try:
+        return int(node.metadata.labels.get(wk.TPU_WORKER_INDEX_LABEL, "0"))
+    except ValueError:
+        return 0
+
+
+def has_index(kube: Client) -> bool:
+    store = getattr(kube, "store", None)
+    return store is not None and (Node, "spec.providerID") in getattr(store, "_indexes", {})

@@ -1,0 +1,84 @@
+"""CloudProvider shim + metrics decorator — mirrors
+pkg/cloudprovider/cloudprovider_test.go (Create/List/Get/Delete through
+mocked cloud + k8s)."""
+
+import pytest
+
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.cloudprovider import (
+    MetricsDecorator, NodeClaimNotFoundError, TPUCloudProvider,
+)
+from gpu_provisioner_tpu.cloudprovider.metrics import METHOD_ERRORS, current_controller
+from gpu_provisioner_tpu.fake import FakeCloud, make_nodeclaim
+from gpu_provisioner_tpu.providers.instance import InstanceProvider, ProviderConfig
+from gpu_provisioner_tpu.runtime import InMemoryClient
+
+from .conftest import async_test
+
+
+def setup():
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=0.01, delete_latency=0.01)
+    provider = InstanceProvider(cloud.nodepools, kube,
+                                ProviderConfig(node_wait_interval=0.01))
+    return kube, cloud, TPUCloudProvider(provider)
+
+
+@async_test
+async def test_create_returns_nodeclaim_view():
+    _, _, cp = setup()
+    out = await cp.create(make_nodeclaim("ws0", "tpu-v5e-16"))
+    assert out.status.provider_id.startswith("gce://")
+    assert out.metadata.labels[wk.CAPACITY_TYPE_LABEL] == wk.CAPACITY_TYPE_ON_DEMAND
+    assert out.metadata.labels[wk.INSTANCE_TYPE_LABEL] == "tpu-v5e-16"
+    assert out.metadata.labels[wk.TPU_TOPOLOGY_LABEL] == "4x4"
+    assert out.metadata.labels[wk.TPU_HOSTS_LABEL] == "2"
+    assert out.metadata.creation_timestamp is not None
+    assert out.status.capacity[wk.TPU_RESOURCE_NAME] == "16"
+
+
+@async_test
+async def test_get_list_delete_roundtrip():
+    _, _, cp = setup()
+    created = await cp.create(make_nodeclaim("ws0"))
+    got = await cp.get(created.status.provider_id)
+    assert got.metadata.name == "ws0"
+    listed = await cp.list()
+    assert [n.metadata.name for n in listed] == ["ws0"]
+    await cp.delete(created)
+    with pytest.raises(NodeClaimNotFoundError):
+        await cp.get(created.status.provider_id)
+    with pytest.raises(NodeClaimNotFoundError):
+        await cp.get("")
+
+
+@async_test
+async def test_instance_types_catalog_exposed():
+    _, _, cp = setup()
+    types = await cp.get_instance_types()
+    assert any(t.name == "tpu-v5p-32" and t.hosts == 4 for t in types)
+
+
+@async_test
+async def test_repair_policies_and_drift():
+    _, _, cp = setup()
+    policies = cp.repair_policies()
+    assert any(p.condition_type == "Ready" and p.condition_status == "Unknown"
+               and p.toleration_duration == 600 for p in policies)
+    assert await cp.is_drifted(make_nodeclaim()) == ""
+
+
+@async_test
+async def test_metrics_decorator_counts_errors():
+    _, _, cp = setup()
+    decorated = MetricsDecorator(cp)
+    current_controller.set("test.controller")
+    before = METHOD_ERRORS.labels("test.controller", "get", "gcp",
+                                  "NodeClaimNotFoundError")._value.get()
+    with pytest.raises(NodeClaimNotFoundError):
+        await decorated.get("gce://p/z/missing-w0")
+    after = METHOD_ERRORS.labels("test.controller", "get", "gcp",
+                                 "NodeClaimNotFoundError")._value.get()
+    assert after == before + 1
+    assert decorated.name() == "gcp"
+    assert decorated.repair_policies()
