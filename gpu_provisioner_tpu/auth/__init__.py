@@ -1,0 +1,7 @@
+"""GCP auth: env config + workload-identity credentials (L1, pkg/auth analog)."""
+
+from .config import Config, build_config, ConfigError  # noqa: F401
+from .credentials import (  # noqa: F401
+    Credentials, FederatedTokenCredential, MetadataServerCredential,
+    StaticTokenCredential, new_credential,
+)

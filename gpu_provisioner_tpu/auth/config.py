@@ -1,0 +1,65 @@
+"""Env-var configuration (pkg/auth/config.go analog).
+
+The reference reads LOCATION / ARM_RESOURCE_GROUP / AZURE_TENANT_ID /
+AZURE_CLIENT_ID / AZURE_CLUSTER_NAME / ARM_SUBSCRIPTION_ID / DEPLOYMENT_MODE
+from env (config.go:75-83) and validates at startup (config.go:128-137),
+panicking early with an actionable message if workload identity is
+misconfigured (pkg/operator/operator.go:46). Same two-layer pattern here with
+the GCP equivalents, wired by the Helm chart.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class Config:
+    project_id: str = ""
+    location: str = ""            # zone for zonal clusters, e.g. us-central2-b
+    cluster_name: str = ""
+    deployment_mode: str = "managed"   # "managed" → ADC/metadata; else federated
+    federated_token_file: str = ""     # workload-identity projected token
+    service_account_email: str = ""
+    e2e_test_mode: bool = False        # reroutes endpoints (azure_client.go:95-100)
+
+    BASE_VARS: tuple[str, ...] = field(default=(
+        "PROJECT_ID", "LOCATION", "CLUSTER_NAME"), repr=False)
+
+    def validate(self) -> None:
+        missing = [v for v in ("project_id", "location", "cluster_name")
+                   if not getattr(self, v)]
+        if missing:
+            raise ConfigError(
+                f"missing required configuration: {', '.join(missing)} — set the "
+                "PROJECT_ID / LOCATION / CLUSTER_NAME environment variables "
+                "(the Helm chart wires these from values.yaml)")
+        if self.deployment_mode not in ("managed", "self-hosted"):
+            raise ConfigError(
+                f"DEPLOYMENT_MODE must be 'managed' or 'self-hosted', got "
+                f"{self.deployment_mode!r}")
+        if self.deployment_mode == "self-hosted" and not self.federated_token_file:
+            raise ConfigError(
+                "DEPLOYMENT_MODE=self-hosted requires GOOGLE_FEDERATED_TOKEN_FILE "
+                "(workload-identity projected token path); for GKE workload "
+                "identity use DEPLOYMENT_MODE=managed")
+
+
+def build_config(env: dict[str, str] | None = None) -> Config:
+    e = env if env is not None else os.environ
+    cfg = Config(
+        project_id=e.get("PROJECT_ID", "").strip(),
+        location=e.get("LOCATION", "").strip(),
+        cluster_name=e.get("CLUSTER_NAME", "").strip(),
+        deployment_mode=e.get("DEPLOYMENT_MODE", "managed").strip() or "managed",
+        federated_token_file=e.get("GOOGLE_FEDERATED_TOKEN_FILE", "").strip(),
+        service_account_email=e.get("GOOGLE_SERVICE_ACCOUNT", "").strip(),
+        e2e_test_mode=e.get("E2E_TEST_MODE", "").strip().lower() == "true",
+    )
+    cfg.validate()
+    return cfg

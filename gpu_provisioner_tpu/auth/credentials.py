@@ -1,0 +1,131 @@
+"""Workload-identity credentials (pkg/auth/cred.go analog).
+
+The reference's credential ladder: managed mode → DefaultAzureCredential,
+self-hosted → ClientAssertionCredential reading the projected AAD JWT from
+disk with a 5-minute re-read cache (cred.go:49-135, azure_client.go:78-89).
+GCP ladder here: managed → GCE metadata-server token (what GKE workload
+identity serves), self-hosted → federated token file exchanged via STS.
+Tokens are cached and re-read/refreshed on the same 5-minute cadence
+(cred.go:126).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Protocol
+
+import httpx
+
+TOKEN_REREAD_INTERVAL = 300.0  # cred.go:126 (5 min)
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+STS_URL = "https://sts.googleapis.com/v1/token"
+CLOUD_PLATFORM_SCOPE = "https://www.googleapis.com/auth/cloud-platform"
+
+
+class Credentials(Protocol):
+    async def token(self) -> str: ...
+
+
+class StaticTokenCredential:
+    """Fixed token — tests and the e2e harness (cred.go:137-153's KeyVault
+    cert path analog: the harness injects a pre-fetched credential)."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+    async def token(self) -> str:
+        return self._token
+
+
+class _CachingCredential:
+    def __init__(self):
+        self._cached: Optional[str] = None
+        self._at = 0.0
+
+    async def token(self) -> str:
+        if self._cached is None or time.monotonic() - self._at > TOKEN_REREAD_INTERVAL:
+            self._cached = await self._fetch()
+            self._at = time.monotonic()
+        return self._cached
+
+    async def _fetch(self) -> str:
+        raise NotImplementedError
+
+
+class MetadataServerCredential(_CachingCredential):
+    """GKE workload identity: the metadata server mints access tokens for the
+    bound GCP service account (managed-mode analog of DefaultAzureCredential)."""
+
+    def __init__(self, http: Optional[httpx.AsyncClient] = None):
+        super().__init__()
+        self.http = http or httpx.AsyncClient(timeout=10.0)
+
+    async def _fetch(self) -> str:
+        r = await self.http.get(METADATA_TOKEN_URL,
+                                headers={"Metadata-Flavor": "Google"})
+        r.raise_for_status()
+        return r.json()["access_token"]
+
+
+class FederatedTokenCredential(_CachingCredential):
+    """Self-hosted: exchange a projected OIDC token for a GCP access token via
+    STS (the AAD ClientAssertionCredential analog, cred.go:49-135). The
+    projected token file is re-read on every refresh — kubelet rotates it."""
+
+    def __init__(self, token_file: str, audience: str,
+                 http: Optional[httpx.AsyncClient] = None):
+        super().__init__()
+        self.token_file = token_file
+        self.audience = audience
+        self.http = http or httpx.AsyncClient(timeout=10.0)
+
+    async def _fetch(self) -> str:
+        with open(self.token_file) as f:
+            subject_token = f.read().strip()
+        r = await self.http.post(STS_URL, data={
+            "grant_type": "urn:ietf:params:oauth:grant-type:token-exchange",
+            "audience": self.audience,
+            "scope": CLOUD_PLATFORM_SCOPE,
+            "subject_token_type": "urn:ietf:params:oauth:token-type:jwt",
+            "requested_token_type": "urn:ietf:params:oauth:token-type:access_token",
+            "subject_token": subject_token,
+        })
+        r.raise_for_status()
+        return r.json()["access_token"]
+
+
+class ImpersonatedCredential(_CachingCredential):
+    """Exchange a base (federated) token for a service-account access token
+    via iamcredentials generateAccessToken — the step that makes
+    GOOGLE_SERVICE_ACCOUNT effective in self-hosted mode (IAM bindings live
+    on the service account, not the workload-identity-pool principal)."""
+
+    def __init__(self, base: Credentials, service_account_email: str,
+                 http: Optional[httpx.AsyncClient] = None):
+        super().__init__()
+        self.base = base
+        self.email = service_account_email
+        self.http = http or httpx.AsyncClient(timeout=10.0)
+
+    async def _fetch(self) -> str:
+        base_token = await self.base.token()
+        url = (f"https://iamcredentials.googleapis.com/v1/projects/-/"
+               f"serviceAccounts/{self.email}:generateAccessToken")
+        r = await self.http.post(url, json={"scope": [CLOUD_PLATFORM_SCOPE]},
+                                 headers={"Authorization": f"Bearer {base_token}"})
+        r.raise_for_status()
+        return r.json()["accessToken"]
+
+
+def new_credential(cfg) -> Credentials:
+    """Credential selection by deployment mode (azure_client.go:78-89)."""
+    if cfg.deployment_mode == "managed":
+        return MetadataServerCredential()
+    audience = (f"//iam.googleapis.com/projects/{cfg.project_id}/"
+                f"locations/global/workloadIdentityPools/kaito/providers/kaito")
+    federated = FederatedTokenCredential(cfg.federated_token_file, audience)
+    if cfg.service_account_email:
+        return ImpersonatedCredential(federated, cfg.service_account_email)
+    return federated

@@ -1,0 +1,14 @@
+"""Reconcile controllers (L4 of the layer map, SURVEY.md §1).
+
+The active controller set replicates what the reference actually runs — its
+vendored Karpenter fork comments out provisioner/disruption/consolidation and
+keeps only: nodeclaim lifecycle, node termination, nodeclaim GC, node health
+(vendor/.../controllers/controllers.go:39-122, SURVEY.md §2b V1) — plus the
+first-party instance GC loop. KAITO owns NodeClaim creation; this controller
+only materializes and reaps them (SURVEY.md §7 hard part 5).
+"""
+
+from .gc import InstanceGCController, NodeClaimGCController  # noqa: F401
+from .health import NodeHealthController  # noqa: F401
+from .lifecycle import LifecycleOptions, NodeClaimLifecycleController  # noqa: F401
+from .termination import EvictionQueue, NodeTerminationController  # noqa: F401

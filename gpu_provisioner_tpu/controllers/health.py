@@ -1,0 +1,103 @@
+"""Node health / auto-repair controller (V8).
+
+Watches Node conditions; when one matches a CloudProvider RepairPolicy and has
+been unhealthy longer than its toleration, force-deletes the owning NodeClaim
+so KAITO recreates it (vendor/.../controllers/node/health/controller.go:
+106-183; flow §3.5 in SURVEY.md). The reference's nodepool/cluster healthy-%
+circuit breakers are commented out there (:130-151); here a cluster-level
+breaker is kept behind an option, default off, to match active behavior while
+leaving the seam.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis.core import Node
+from ..apis.karpenter import NodeClaim
+from ..apis.serde import now
+from ..runtime import NotFoundError, Request, Result
+from ..runtime.client import Client
+from ..runtime.events import Recorder
+from .utils import nodeclaim_for_node
+
+log = logging.getLogger("controllers.health")
+
+
+@dataclass
+class HealthOptions:
+    # Cluster-wide circuit breaker: skip repair if more than this fraction of
+    # managed nodes is unhealthy (0 disables, matching the reference's
+    # commented-out breaker).
+    max_unhealthy_fraction: float = 0.0
+
+
+class NodeHealthController:
+    NAME = "node.health"
+
+    def __init__(self, client: Client, cloudprovider,
+                 recorder: Optional[Recorder] = None,
+                 options: Optional[HealthOptions] = None):
+        self.client = client
+        self.cp = cloudprovider
+        self.recorder = recorder
+        self.opts = options or HealthOptions()
+
+    async def reconcile(self, req: Request) -> Result:
+        try:
+            node = await self.client.get(Node, req.name)
+        except NotFoundError:
+            return Result()
+        if node.metadata.deletion_timestamp is not None:
+            return Result()
+
+        match = self._match_policy(node)
+        if match is None:
+            return Result()
+        condition, policy = match
+
+        elapsed = 0.0
+        if condition.last_transition_time is not None:
+            elapsed = (now() - condition.last_transition_time).total_seconds()
+        if elapsed < policy.toleration_duration:
+            # requeue until the toleration elapses (health/controller.go:121-127)
+            return Result(requeue_after=policy.toleration_duration - elapsed)
+
+        if await self._circuit_broken():
+            log.warning("repair of %s skipped: cluster unhealthy fraction over limit",
+                        node.metadata.name)
+            return Result(requeue_after=policy.toleration_duration)
+
+        nc = await nodeclaim_for_node(self.client, node)
+        if nc is None or nc.metadata.deletion_timestamp is not None:
+            return Result()
+        log.info("repairing node %s: %s=%s for %.0fs; deleting nodeclaim %s",
+                 node.metadata.name, condition.type, condition.status, elapsed,
+                 nc.metadata.name)
+        if self.recorder is not None:
+            await self.recorder.publish(nc, "Warning", "NodeRepair",
+                                        f"node {node.metadata.name} unhealthy: "
+                                        f"{condition.type}={condition.status}")
+        try:
+            await self.client.delete(NodeClaim, nc.metadata.name)
+        except NotFoundError:
+            pass
+        return Result()
+
+    def _match_policy(self, node: Node):
+        for policy in self.cp.repair_policies():
+            for c in node.status.conditions:
+                if c.type == policy.condition_type and c.status == policy.condition_status:
+                    return c, policy
+        return None
+
+    async def _circuit_broken(self) -> bool:
+        if self.opts.max_unhealthy_fraction <= 0:
+            return False
+        nodes = await self.client.list(Node)
+        if not nodes:
+            return False
+        unhealthy = sum(1 for n in nodes if self._match_policy(n) is not None)
+        return unhealthy / len(nodes) > self.opts.max_unhealthy_fraction

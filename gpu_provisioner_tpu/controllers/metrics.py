@@ -1,0 +1,41 @@
+"""Controller-level Prometheus metrics.
+
+Name-compatible with the reference's nodeclaim metrics
+(vendor/sigs.k8s.io/karpenter/pkg/metrics/metrics.go:33-60 and
+lifecycle/controller.go:249-266), plus a provision-duration histogram — the
+headline NodeClaim→Ready latency from BASELINE.json that the reference never
+measured.
+"""
+
+from prometheus_client import REGISTRY, Counter, Histogram
+
+
+def _get_or_create(cls, name, doc, labelnames, **kw):
+    try:
+        return cls(name, doc, labelnames, **kw)
+    except ValueError:
+        return REGISTRY._names_to_collectors[name]
+
+
+NODECLAIMS_CREATED = _get_or_create(
+    Counter, "karpenter_nodeclaims_created_total",
+    "NodeClaims launched, by provider.", ["provider"])
+
+NODECLAIMS_TERMINATED = _get_or_create(
+    Counter, "karpenter_nodeclaims_terminated_total",
+    "NodeClaims terminated, by provider.", ["provider"])
+
+TERMINATION_DURATION = _get_or_create(
+    Histogram, "karpenter_nodeclaims_termination_duration_seconds",
+    "Time from deletion request to finalizer removal.", ["provider"],
+    buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800))
+
+PROVISION_DURATION = _get_or_create(
+    Histogram, "karpenter_nodeclaims_provision_duration_seconds",
+    "Time from NodeClaim creation to Initialized (NodeClaim→Ready).",
+    ["provider", "instance_type"],
+    buckets=(5, 15, 30, 60, 120, 180, 300, 420, 600, 900))
+
+CHIPS_PROVISIONED = _get_or_create(
+    Counter, "tpu_chips_provisioned_total",
+    "Total TPU chips brought to Ready.", ["generation"])

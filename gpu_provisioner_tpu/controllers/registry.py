@@ -1,0 +1,71 @@
+"""Controller registry: builds the active controller set (V1 analog).
+
+The reference's registry (vendor/.../controllers/controllers.go:39-122) is a
+patched Karpenter list with most controllers commented out; the active subset
+is: nodeclaim lifecycle, node termination, nodeclaim GC, node health (iff
+repair policies + feature gate), plus the first-party instance GC
+(pkg/controllers/controllers.go:26-31). This mirrors that set exactly and
+keeps the seam open for future controllers (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..apis.karpenter import NodeClaim
+from ..runtime import Controller, Request, Singleton
+from ..runtime.client import Client
+from ..runtime.events import Recorder
+from .gc import GCOptions, InstanceGCController, NodeClaimGCController
+from .health import HealthOptions, NodeHealthController
+from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
+from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
+
+
+def node_to_nodeclaim_requests(node: Node) -> list[Request]:
+    pool = (node.metadata.labels.get(wk.TPU_SLICE_ID_LABEL)
+            or node.metadata.labels.get(wk.GKE_NODEPOOL_LABEL))
+    return [Request(name=pool)] if pool else []
+
+
+def build_controllers(client: Client, cloudprovider,
+                      recorder: Optional[Recorder] = None,
+                      lifecycle_options: Optional[LifecycleOptions] = None,
+                      termination_options: Optional[TerminationOptions] = None,
+                      gc_options: Optional[GCOptions] = None,
+                      health_options: Optional[HealthOptions] = None,
+                      node_repair: bool = True,
+                      max_concurrent_reconciles: int = 64,
+                      ) -> tuple[list[Controller], EvictionQueue]:
+    """Assemble the active controller set. ``max_concurrent_reconciles``
+    scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
+    lifecycle/controller.go:56-58,89 — asyncio workers are cheap but bounded
+    for fairness)."""
+    lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
+                                            lifecycle_options)
+    eviction = EvictionQueue(client)
+    termination = NodeTerminationController(client, cloudprovider, eviction,
+                                            recorder, termination_options)
+    instance_gc = InstanceGCController(client, cloudprovider, gc_options)
+    nodeclaim_gc = NodeClaimGCController(client, cloudprovider, gc_options)
+
+    controllers = [
+        Controller(lifecycle.NAME, lifecycle,
+                   max_concurrent=max_concurrent_reconciles)
+        .watches(NodeClaim)
+        .watches(Node, map_fn=node_to_nodeclaim_requests),
+        Controller(termination.NAME, termination, max_concurrent=16)
+        .watches(Node),
+        Controller(instance_gc.NAME, Singleton(instance_gc.run_once),
+                   max_concurrent=1).as_singleton(),
+        Controller(nodeclaim_gc.NAME, Singleton(nodeclaim_gc.run_once),
+                   max_concurrent=1).as_singleton(),
+    ]
+    # Node health only with repair policies + gate (controllers.go:110-113).
+    if node_repair and cloudprovider.repair_policies():
+        health = NodeHealthController(client, cloudprovider, recorder, health_options)
+        controllers.append(
+            Controller(health.NAME, health, max_concurrent=8).watches(Node))
+    return controllers, eviction

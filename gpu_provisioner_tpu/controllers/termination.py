@@ -1,0 +1,204 @@
+"""Node termination controller + Terminator + eviction queue (V7).
+
+Re-creates the node-finalizer flow of vendor/.../controllers/node/termination/:
+taint ``karpenter.sh/disrupted:NoSchedule`` (controller.go:135-141), drain the
+pods through a rate-limited eviction queue (terminator/terminator.go:96-117,
+eviction.go:93-140), await volume detachment, await instance termination, then
+remove the node finalizer (controller.go:143-190). Drain short-circuits when
+the backing instance is already gone (controller.go:117-127) and when the
+NodeClaim's termination-grace deadline has passed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node, Pod, Taint, VolumeAttachment
+from ..apis.karpenter import DRAINED, NodeClaim, VOLUMES_DETACHED
+from ..apis.serde import now, parse_time
+from ..errors import NodeClaimNotFoundError
+from ..runtime import NotFoundError, Request, Result
+from ..runtime.client import Client, patch_retry
+from ..runtime.events import Recorder
+from .utils import nodeclaim_for_node
+
+log = logging.getLogger("controllers.termination")
+
+
+class EvictionQueue:
+    """Rate-limited pod evictor (terminator/eviction.go:93-140). Evictions in
+    this in-process runtime are pod deletes; against a real apiserver the same
+    seam posts Eviction subresources."""
+
+    def __init__(self, client: Client, qps: float = 10.0):
+        self.client = client
+        self.interval = 1.0 / qps
+        self._queued: set[tuple[str, str]] = set()
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(), name="eviction-queue")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def enqueue(self, pod: Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if key not in self._queued:
+            self._queued.add(key)
+            self._q.put_nowait(key)
+
+    async def _run(self) -> None:
+        while True:
+            ns, name = await self._q.get()
+            try:
+                await self.client.delete(Pod, name, ns)
+            except NotFoundError:
+                self._queued.discard((ns, name))  # already gone — allow re-use
+            except Exception as e:  # noqa: BLE001 — requeue on transient errors
+                log.warning("evicting %s/%s: %s", ns, name, e)
+                self._q.put_nowait((ns, name))
+            else:
+                self._queued.discard((ns, name))
+            await asyncio.sleep(self.interval)
+
+
+@dataclass
+class TerminationOptions:
+    requeue: float = 1.0
+    instance_requeue: float = 5.0
+    volume_detach_timeout: float = 60.0
+
+
+class NodeTerminationController:
+    NAME = "node.termination"
+
+    def __init__(self, client: Client, cloudprovider, queue: EvictionQueue,
+                 recorder: Optional[Recorder] = None,
+                 options: Optional[TerminationOptions] = None):
+        self.client = client
+        self.cp = cloudprovider
+        self.queue = queue
+        self.recorder = recorder
+        self.opts = options or TerminationOptions()
+
+    async def reconcile(self, req: Request) -> Result:
+        try:
+            node = await self.client.get(Node, req.name)
+        except NotFoundError:
+            return Result()
+        if (node.metadata.deletion_timestamp is None
+                or wk.TERMINATION_FINALIZER not in node.metadata.finalizers):
+            return Result()
+
+        await self._taint_disrupted(node)
+        nc = await nodeclaim_for_node(self.client, node)
+
+        if not await self._instance_gone(node):
+            if not self._grace_expired(nc):
+                drained = await self._drain(node)
+                if nc is not None:
+                    await self._set_cond(nc, DRAINED, drained, "Draining")
+                if not drained:
+                    return Result(requeue_after=self.opts.requeue)
+
+                detached = await self._volumes_detached(node)
+                if nc is not None:
+                    await self._set_cond(nc, VOLUMES_DETACHED, detached, "AwaitingDetach")
+                if not detached and not self._detach_timed_out(node):
+                    return Result(requeue_after=self.opts.requeue)
+
+            # Grace expiry abandons the drain, never the instance wait: the
+            # finalizer must not drop while the TPU VM is alive or the kubelet
+            # re-registers the Node. NodeClaim finalize drives the delete.
+            if not await self._instance_gone(node):
+                return Result(requeue_after=self.opts.instance_requeue)
+
+        def drop(obj: Node):
+            if wk.TERMINATION_FINALIZER not in obj.metadata.finalizers:
+                return False
+            obj.metadata.finalizers.remove(wk.TERMINATION_FINALIZER)
+        await patch_retry(self.client, Node, node.metadata.name, drop)
+        return Result()
+
+    async def _taint_disrupted(self, node: Node) -> None:
+        def mutate(n: Node):
+            if any(t.key == wk.DISRUPTED_TAINT for t in n.spec.taints):
+                return False
+            n.spec.taints.append(Taint(key=wk.DISRUPTED_TAINT, effect="NoSchedule"))
+        await patch_retry(self.client, Node, node.metadata.name, mutate)
+
+    async def _instance_gone(self, node: Node) -> bool:
+        if not node.spec.provider_id:
+            return True
+        try:
+            await self.cp.get(node.spec.provider_id)
+            return False
+        except NodeClaimNotFoundError:
+            return True
+
+    def _grace_expired(self, nc: Optional[NodeClaim]) -> bool:
+        """Past the termination-grace deadline, drain is abandoned
+        (terminator checks the annotation stamped by the lifecycle finalize)."""
+        if nc is None:
+            return False
+        raw = nc.metadata.annotations.get(wk.TERMINATION_TIMESTAMP_ANNOTATION)
+        if not raw:
+            return False
+        try:
+            return now() >= parse_time(raw)
+        except ValueError:
+            return False
+
+    async def _drain(self, node: Node) -> bool:
+        """Evict all drainable pods; True when none remain
+        (terminator.go:96-117). Daemonset pods and terminal pods are skipped;
+        higher-priority pods are evicted only after lower-priority ones are
+        gone (the reference drains in priority waves)."""
+        pods = [p for p in await self.client.list(Pod)
+                if p.spec.node_name == node.metadata.name
+                and not p.is_owned_by_daemonset() and not p.is_terminal()]
+        if not pods:
+            return True
+        min_priority = min(p.spec.priority for p in pods)
+        for p in pods:
+            if p.spec.priority == min_priority:
+                self.queue.enqueue(p)
+        return False
+
+    async def _volumes_detached(self, node: Node) -> bool:
+        attachments = [va for va in await self.client.list(VolumeAttachment)
+                       if va.spec.node_name == node.metadata.name]
+        return not attachments
+
+    def _detach_timed_out(self, node: Node) -> bool:
+        dt = node.metadata.deletion_timestamp
+        return dt is not None and (now() - dt).total_seconds() > self.opts.volume_detach_timeout
+
+    async def _set_cond(self, nc: NodeClaim, ctype: str, ok: bool, reason: str) -> None:
+        def mutate(obj: NodeClaim):
+            cs = obj.status_conditions
+            before = [c.status for c in obj.status.conditions if c.type == ctype]
+            if ok:
+                cs.set_true(ctype, ctype)
+            else:
+                cs.set_false(ctype, reason)
+            after = [c.status for c in obj.status.conditions if c.type == ctype]
+            return None if before != after else False
+        try:
+            await patch_retry(self.client, NodeClaim, nc.metadata.name, mutate,
+                              status=True)
+        except NotFoundError:
+            pass

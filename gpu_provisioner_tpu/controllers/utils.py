@@ -1,0 +1,88 @@
+"""Shared controller helpers: ownership model, node↔nodeclaim correlation.
+
+The patched ownership model is load-bearing (SURVEY.md §2b V11): a NodeClaim
+is managed iff its NodeClassRef matches a supported NodeClass **or** it
+carries the kaito workspace/ragengine labels
+(vendor/.../utils/nodeclaim/nodeclaim.go:40-75).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..apis.kaito import KaitoNodeClass
+from ..apis.karpenter import NodeClaim
+from ..runtime.client import Client
+
+_SUPPORTED_NODECLASS_KINDS = {(KaitoNodeClass.API_VERSION.split("/")[0], KaitoNodeClass.KIND)}
+
+
+def is_managed(nc: NodeClaim) -> bool:
+    if wk.KAITO_WORKSPACE_LABEL in nc.metadata.labels:
+        return True
+    if wk.KAITO_RAGENGINE_LABEL in nc.metadata.labels:
+        return True
+    ref = nc.spec.node_class_ref
+    return ref is not None and (ref.group, ref.kind) in _SUPPORTED_NODECLASS_KINDS
+
+
+async def list_managed(client: Client) -> list[NodeClaim]:
+    return [nc for nc in await client.list(NodeClaim) if is_managed(nc)]
+
+
+async def slice_nodes(client: Client, nodeclaim_name: str) -> list[Node]:
+    """All Node objects of a NodeClaim's slice, correlated by the GKE
+    node-pool label (the analog of getNodesByName's agentpool-label match,
+    reference instance.go:371-385). One node for single-host shapes, N for
+    multi-host."""
+    return await client.list(Node, labels={wk.GKE_NODEPOOL_LABEL: nodeclaim_name})
+
+
+async def nodeclaim_for_node(client: Client, node: Node) -> Optional[NodeClaim]:
+    """Correlate a Node back to its NodeClaim: owner reference first, then the
+    slice-id/node-pool label, then providerID (reference correlates purely by
+    providerID via a field index)."""
+    for ref in node.metadata.owner_references:
+        if ref.kind == NodeClaim.KIND:
+            try:
+                return await client.get(NodeClaim, ref.name)
+            except Exception:
+                return None
+    pool = (node.metadata.labels.get(wk.TPU_SLICE_ID_LABEL)
+            or node.metadata.labels.get(wk.GKE_NODEPOOL_LABEL))
+    if pool:
+        try:
+            return await client.get(NodeClaim, pool)
+        except Exception:
+            pass
+    pid = node.spec.provider_id
+    if pid:
+        for nc in await client.list(NodeClaim):
+            if nc.status.provider_id == pid:
+                return nc
+    return None
+
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)([smh])")
+_UNIT = {"s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(s: Optional[str]) -> Optional[float]:
+    """Parse a metav1.Duration-ish string ("30s", "5m", "1h30m") to seconds."""
+    if not s:
+        return None
+    out, matched = 0.0, False
+    for m in _DURATION_RE.finditer(s):
+        out += float(m.group(1)) * _UNIT[m.group(2)]
+        matched = True
+    return out if matched else None
+
+
+def expected_hosts(nc: NodeClaim) -> int:
+    try:
+        return max(1, int(nc.metadata.labels.get(wk.TPU_HOSTS_LABEL, "1")))
+    except ValueError:
+        return 1

@@ -1,0 +1,116 @@
+"""envtest: the whole provisioner in-process against the simulated cloud.
+
+The reference defers realism to a real-AKS e2e suite and tests units against
+mocks (SURVEY.md §4); BASELINE.json asks the TPU build to do better with an
+envtest config — reconcile real NodeClaim manifests through the real
+controllers against the fake cloud, entirely in-process. This harness is that
+config, reused by unit/e2e tests, ``bench.py`` and the operator's
+``--simulate`` mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .apis.core import Node
+from .apis.karpenter import NodeClaim
+from .apis.meta import CONDITION_READY
+from .cloudprovider import MetricsDecorator, TPUCloudProvider
+from .controllers.gc import GCOptions
+from .controllers.lifecycle import LifecycleOptions
+from .controllers.registry import build_controllers
+from .controllers.termination import TerminationOptions
+from .fake.cloud import FakeCloud
+from .providers.instance import InstanceProvider, ProviderConfig
+from .runtime import InMemoryClient, Manager
+from .runtime.events import Recorder
+
+
+@dataclass
+class EnvtestOptions:
+    create_latency: float = 0.05
+    delete_latency: float = 0.02
+    node_join_delay: float = 0.0
+    node_ready_delay: float = 0.0
+    qr_step_latency: float = 0.02
+    node_wait_interval: float = 0.02
+    gc_interval: float = 0.2
+    leak_grace: float = 0.2
+    lifecycle: LifecycleOptions = field(default_factory=lambda: LifecycleOptions(
+        termination_requeue=0.05, registration_requeue=0.05))
+    termination: TerminationOptions = field(default_factory=lambda: TerminationOptions(
+        requeue=0.05, instance_requeue=0.05))
+    max_concurrent_reconciles: int = 64
+
+
+class Env:
+    """One in-process provisioner: store + fake cloud + full controller set."""
+
+    def __init__(self, options: Optional[EnvtestOptions] = None):
+        self.opts = options or EnvtestOptions()
+        self.client = InMemoryClient()
+        self.client.store.add_index(Node, "spec.providerID",
+                                    lambda o: [o.spec.provider_id])
+        self.cloud = FakeCloud(
+            self.client,
+            create_latency=self.opts.create_latency,
+            delete_latency=self.opts.delete_latency,
+            node_join_delay=self.opts.node_join_delay,
+            node_ready_delay=self.opts.node_ready_delay,
+            qr_step_latency=self.opts.qr_step_latency)
+        self.provider = InstanceProvider(
+            self.cloud.nodepools, self.client,
+            ProviderConfig(node_wait_interval=self.opts.node_wait_interval),
+            queued=self.cloud.queuedresources)
+        self.cloudprovider = MetricsDecorator(TPUCloudProvider(self.provider))
+        self.recorder = Recorder(self.client)
+        controllers, self.eviction = build_controllers(
+            self.client, self.cloudprovider, self.recorder,
+            lifecycle_options=self.opts.lifecycle,
+            termination_options=self.opts.termination,
+            gc_options=GCOptions(interval=self.opts.gc_interval,
+                                 leak_grace=self.opts.leak_grace),
+            max_concurrent_reconciles=self.opts.max_concurrent_reconciles)
+        self.manager = Manager(self.client).register(*controllers)
+
+    async def __aenter__(self) -> "Env":
+        self.eviction.start()
+        await self.manager.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.manager.stop()
+        await self.eviction.stop()
+
+    # ------------------------------------------------------------- helpers
+    async def wait_ready(self, name: str, timeout: float = 10.0) -> NodeClaim:
+        """Block until the NodeClaim's Ready root condition is True."""
+        return await self._wait(name, lambda nc: nc.status_conditions.is_true(
+            CONDITION_READY), timeout, "Ready")
+
+    async def wait_gone(self, name: str, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            try:
+                await self.client.get(NodeClaim, name)
+            except Exception:
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"nodeclaim {name} still present after {timeout}s")
+            await asyncio.sleep(0.01)
+
+    async def _wait(self, name: str, predicate, timeout: float, what: str) -> NodeClaim:
+        deadline = asyncio.get_event_loop().time() + timeout
+        last = None
+        while True:
+            last = await self.client.get(NodeClaim, name)
+            if predicate(last):
+                return last
+            if asyncio.get_event_loop().time() > deadline:
+                conds = {c.type: f"{c.status}/{c.reason}"
+                         for c in last.status.conditions}
+                raise TimeoutError(
+                    f"nodeclaim {name} not {what} after {timeout}s; conditions: {conds}")
+            await asyncio.sleep(0.01)

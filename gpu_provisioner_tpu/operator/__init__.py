@@ -1,0 +1,4 @@
+"""Operator / process runtime (L5): options, logging, servers, assembly."""
+
+from .options import Options, parse_options  # noqa: F401
+from .logging import setup_logging  # noqa: F401

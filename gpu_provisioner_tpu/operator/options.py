@@ -1,0 +1,97 @@
+"""Operator options: flags with env-var fallback (V9 analog).
+
+Mirrors vendor/.../operator/options/options.go:67-141 — notable defaults kept:
+leader election DISABLED by default (:117, DISABLE_LEADER_ELECTION=true),
+metrics on 8080 (:112), health probes on 8081 (:113), feature gates parsed
+from a comma string with NodeRepair defaulting true (:134, chart value).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    node_repair: bool = True
+
+
+@dataclass
+class Options:
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    disable_leader_election: bool = True
+    enable_profiling: bool = False
+    log_level: str = "info"
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    # lifecycle knobs (SURVEY.md §7 step 5: liveness behind a flag, generous)
+    liveness_enabled: bool = True
+    launch_timeout_seconds: float = 1800.0
+    registration_timeout_seconds: float = 2400.0
+    gc_interval_seconds: float = 120.0
+    gc_leak_grace_seconds: float = 30.0
+    max_concurrent_reconciles: int = 64
+    simulate: bool = False
+    simulate_claims: int = 0
+    simulate_shape: str = "tpu-v5e-8"
+
+
+def _env_bool(e, key: str, default: bool) -> bool:
+    raw = e.get(key, "").strip().lower()
+    return default if raw == "" else raw in ("1", "true", "yes")
+
+
+def parse_feature_gates(raw: str, base: FeatureGates) -> FeatureGates:
+    """Parse "NodeRepair=true,Other=false" (options.go:177-204)."""
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        if k.strip() == "NodeRepair":
+            base.node_repair = v.strip().lower() == "true"
+    return base
+
+
+def parse_options(argv=None, env=None) -> Options:
+    e = env if env is not None else os.environ
+    o = Options(
+        metrics_port=int(e.get("METRICS_PORT", "8080")),
+        health_probe_port=int(e.get("HEALTH_PROBE_PORT", "8081")),
+        disable_leader_election=_env_bool(e, "DISABLE_LEADER_ELECTION", True),
+        enable_profiling=_env_bool(e, "ENABLE_PROFILING", False),
+        log_level=e.get("LOG_LEVEL", "info"),
+        liveness_enabled=_env_bool(e, "LIVENESS_ENABLED", True),
+        launch_timeout_seconds=float(e.get("LAUNCH_TIMEOUT_SECONDS", "1800")),
+        registration_timeout_seconds=float(e.get("REGISTRATION_TIMEOUT_SECONDS", "2400")),
+        gc_interval_seconds=float(e.get("GC_INTERVAL_SECONDS", "120")),
+        gc_leak_grace_seconds=float(e.get("GC_LEAK_GRACE_SECONDS", "30")),
+        max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
+    )
+    o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)
+
+    p = argparse.ArgumentParser(prog="tpu-provisioner")
+    p.add_argument("--metrics-port", type=int, default=o.metrics_port)
+    p.add_argument("--health-probe-port", type=int, default=o.health_probe_port)
+    p.add_argument("--log-level", default=o.log_level)
+    p.add_argument("--enable-profiling", action="store_true",
+                   default=o.enable_profiling)
+    p.add_argument("--feature-gates", default="")
+    p.add_argument("--simulate", action="store_true",
+                   help="run against the in-process simulated cloud (envtest)")
+    p.add_argument("--simulate-claims", type=int, default=0,
+                   help="with --simulate: create N NodeClaims, wait Ready, exit")
+    p.add_argument("--simulate-shape", default="tpu-v5e-8")
+    args = p.parse_args(argv)
+
+    o.metrics_port = args.metrics_port
+    o.health_probe_port = args.health_probe_port
+    o.log_level = args.log_level
+    o.enable_profiling = args.enable_profiling
+    o.feature_gates = parse_feature_gates(args.feature_gates, o.feature_gates)
+    o.simulate = args.simulate
+    o.simulate_claims = args.simulate_claims
+    o.simulate_shape = args.simulate_shape
+    return o

@@ -1,0 +1,71 @@
+"""Metrics + health-probe HTTP servers (V9: operator.go:157-224).
+
+Metrics on :8080 (/metrics, Prometheus text format), probes on :8081
+(/healthz always-ok once the process is up; /readyz only after the manager's
+watch caches started and required kinds are registered — the analog of the
+reference's cache-sync + NodeClaim-CRD-presence readyz, operator.go:207-224).
+pprof analog behind --enable-profiling: /debug/tasks dumps live asyncio tasks
+with stacks (operator.go:185-200 exposes Go pprof there).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+
+from aiohttp import web
+from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
+
+from ..apis.karpenter import NodeClaim
+from ..apis.meta import _KINDS
+from ..runtime.controller import Manager
+
+
+def build_apps(manager: Manager, enable_profiling: bool = False):
+    metrics = web.Application()
+
+    async def metrics_handler(_req):
+        return web.Response(body=generate_latest(),
+                            content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    metrics.router.add_get("/metrics", metrics_handler)
+
+    if enable_profiling:
+        async def tasks_handler(_req):
+            lines = []
+            for t in asyncio.all_tasks():
+                lines.append(f"== {t.get_name()} done={t.done()}")
+                for frame in t.get_stack(limit=8):
+                    lines.append("".join(traceback.format_stack(frame, limit=1)))
+            return web.Response(text="\n".join(lines))
+
+        metrics.router.add_get("/debug/tasks", tasks_handler)
+
+    health = web.Application()
+
+    async def healthz(_req):
+        return web.Response(text="ok")
+
+    async def readyz(_req):
+        if not manager.started.is_set():
+            return web.Response(status=503, text="manager not started")
+        if NodeClaim.KIND not in _KINDS:
+            return web.Response(status=503, text="NodeClaim kind not registered")
+        return web.Response(text="ok")
+
+    health.router.add_get("/healthz", healthz)
+    health.router.add_get("/readyz", readyz)
+    return metrics, health
+
+
+async def start_servers(manager: Manager, metrics_port: int, health_port: int,
+                        enable_profiling: bool = False):
+    metrics_app, health_app = build_apps(manager, enable_profiling)
+    runners = []
+    for app, port in ((metrics_app, metrics_port), (health_app, health_port)):
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", port)
+        await site.start()
+        runners.append(runner)
+    return runners

@@ -1,0 +1,202 @@
+"""Controller behavior through the envtest harness: the provisioning ladder
+(§3.2), deprovision flow (§3.3), both GC loops (§3.4), auto-repair (§3.5)."""
+
+import asyncio
+
+import pytest
+
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Node, Pod, PodSpec
+from gpu_provisioner_tpu.apis.karpenter import (
+    DRAINED, INITIALIZED, LAUNCHED, NodeClaim, REGISTERED,
+)
+from gpu_provisioner_tpu.apis.meta import ObjectMeta
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.providers.gcp import APIError
+from gpu_provisioner_tpu.runtime import NotFoundError
+
+from .conftest import async_test
+
+
+@async_test
+async def test_provision_ladder_single_host():
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("ws0", "tpu-v5e-8"))
+        nc = await env.wait_ready("ws0")
+        cs = nc.status_conditions
+        assert cs.is_true(LAUNCHED) and cs.is_true(REGISTERED) and cs.is_true(INITIALIZED)
+        assert nc.status.provider_id.startswith("gce://")
+        assert nc.status.node_name == "gke-kaito-ws0-w0"
+        assert wk.TERMINATION_FINALIZER in nc.metadata.finalizers
+        # topology labels propagated onto the CR (instanceToNodeClaim analog)
+        assert nc.metadata.labels[wk.TPU_TOPOLOGY_LABEL] == "2x4"
+        # and synced onto the node (registration)
+        node = await env.client.get(Node, "gke-kaito-ws0-w0")
+        assert node.metadata.labels[wk.KAITO_WORKSPACE_LABEL] == "ws"
+        assert wk.TERMINATION_FINALIZER in node.metadata.finalizers
+        assert any(o.kind == "NodeClaim" for o in node.metadata.owner_references)
+
+
+@async_test
+async def test_steady_state_has_no_write_churn():
+    # Regression: a no-op status flush must not bump resourceVersion, or the
+    # controller's own watch feeds it forever (reconcile hot loop).
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("calm"))
+        await env.wait_ready("calm")
+        await asyncio.sleep(0.2)  # let in-flight reconciles settle
+        rv1 = (await env.client.get(NodeClaim, "calm")).metadata.resource_version
+        await asyncio.sleep(0.5)
+        rv2 = (await env.client.get(NodeClaim, "calm")).metadata.resource_version
+        assert rv1 == rv2, "steady-state NodeClaim is being rewritten every reconcile"
+
+
+@async_test
+async def test_provision_multi_host_v5p_32():
+    opts = EnvtestOptions(node_join_delay=0.02, node_ready_delay=0.05)
+    async with Env(opts) as env:
+        await env.client.create(make_nodeclaim("big", "tpu-v5p-32"))
+        nc = await env.wait_ready("big")
+        nodes = await env.client.list(Node, labels={wk.GKE_NODEPOOL_LABEL: "big"})
+        assert len(nodes) == 4
+        assert nc.status.node_name == "gke-kaito-big-w0"
+        idx = sorted(n.metadata.labels[wk.TPU_WORKER_INDEX_LABEL] for n in nodes)
+        assert idx == list("0123")
+
+
+@async_test
+async def test_unmanaged_nodeclaim_ignored():
+    async with Env() as env:
+        nc = make_nodeclaim("rogue")
+        nc.metadata.labels = {}  # no kaito labels
+        nc.spec.node_class_ref = None
+        await env.client.create(nc)
+        await asyncio.sleep(0.3)
+        got = await env.client.get(NodeClaim, "rogue")
+        assert got.status.conditions == [] and got.metadata.finalizers == []
+        assert env.cloud.nodepools.pools == {}
+
+
+@async_test
+async def test_insufficient_capacity_deletes_nodeclaim():
+    async with Env() as env:
+        env.cloud.nodepools.fail("begin_create", APIError("stockout", code=429))
+        await env.client.create(make_nodeclaim("oom"))
+        await env.wait_gone("oom", timeout=5)
+
+
+@async_test
+async def test_transient_create_error_retries_to_ready():
+    async with Env() as env:
+        env.cloud.nodepools.fail("begin_create", APIError("flake", code=500), times=2)
+        await env.client.create(make_nodeclaim("flaky"))
+        nc = await env.wait_ready("flaky")
+        assert nc.status_conditions.is_true(LAUNCHED)
+        assert env.cloud.nodepools.calls["begin_create"] >= 3
+
+
+@async_test
+async def test_deprovision_flow_drains_and_deletes_pool():
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("ws0"))
+        await env.wait_ready("ws0")
+        # park a workload pod on the node
+        await env.client.create(Pod(
+            metadata=ObjectMeta(name="inference", namespace="default"),
+            spec=PodSpec(node_name="gke-kaito-ws0-w0")))
+        await env.client.delete(NodeClaim, "ws0")
+        await env.wait_gone("ws0")
+        assert env.cloud.nodepools.pools == {}
+        assert await env.client.list(Node) == []
+        with pytest.raises(NotFoundError):
+            await env.client.get(Pod, "inference", "default")  # evicted
+
+
+@async_test
+async def test_node_delete_triggers_drain_condition():
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("ws0"))
+        await env.wait_ready("ws0")
+        await env.client.create(Pod(
+            metadata=ObjectMeta(name="p0", namespace="default"),
+            spec=PodSpec(node_name="gke-kaito-ws0-w0")))
+        await env.client.delete(NodeClaim, "ws0")
+        await env.wait_gone("ws0")
+        # Drained condition was surfaced during teardown (best-effort check on
+        # the CR having been deleted; pod must be gone)
+        with pytest.raises(NotFoundError):
+            await env.client.get(Pod, "p0", "default")
+
+
+@async_test
+async def test_instance_gc_reaps_leaked_pool():
+    async with Env() as env:
+        # create through the provider directly — no NodeClaim backs the pool
+        await env.provider.create(make_nodeclaim("leak"))
+        assert "leak" in env.cloud.nodepools.pools
+        deadline = asyncio.get_event_loop().time() + 5
+        while "leak" in env.cloud.nodepools.pools:
+            assert asyncio.get_event_loop().time() < deadline, "GC never reaped pool"
+            await asyncio.sleep(0.05)
+        # orphan nodes reaped too
+        deadline = asyncio.get_event_loop().time() + 5
+        while await env.client.list(Node):
+            assert asyncio.get_event_loop().time() < deadline, "GC never reaped nodes"
+            await asyncio.sleep(0.05)
+
+
+@async_test
+async def test_nodeclaim_gc_reaps_vanished_instance():
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("ws0"))
+        await env.wait_ready("ws0")
+        # instance vanishes out from under the claim; kubelet goes dark
+        env.cloud.nodepools.pools.clear()
+        node = await env.client.get(Node, "gke-kaito-ws0-w0")
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                c.status = "False"
+        await env.client.update_status(node)
+        await env.wait_gone("ws0", timeout=5)
+
+
+@async_test
+async def test_repair_unhealthy_node_replaces_nodeclaim():
+    async with Env() as env:
+        # shrink the toleration so the test runs in milliseconds
+        env.cloudprovider.inner.repair_policies = lambda: [
+            __import__("gpu_provisioner_tpu.cloudprovider.types",
+                       fromlist=["RepairPolicy"]).RepairPolicy("Ready", "False", 0.1)]
+        await env.client.create(make_nodeclaim("sick"))
+        await env.wait_ready("sick")
+        node = await env.client.get(Node, "gke-kaito-sick-w0")
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                c.status = "False"
+                c.reason = "KubeletDead"
+        await env.client.update_status(node)
+        await env.wait_gone("sick", timeout=5)  # repair deletes the claim
+
+
+@async_test
+async def test_liveness_timeout_deletes_stuck_claim():
+    opts = EnvtestOptions()
+    opts.lifecycle.launch_timeout = 0.2
+    async with Env(opts) as env:
+        env.cloud.nodepools.fail("begin_create", APIError("down", code=500), times=10**6)
+        await env.client.create(make_nodeclaim("stuck"))
+        await env.wait_gone("stuck", timeout=5)
+
+
+@async_test
+async def test_queued_provisioning_end_to_end():
+    opts = EnvtestOptions(qr_step_latency=0.05)
+    async with Env(opts) as env:
+        from gpu_provisioner_tpu.providers.instance import PROVISIONING_MODE_ANNOTATION
+        await env.client.create(make_nodeclaim(
+            "qr0", "tpu-v5e-16",
+            annotations={PROVISIONING_MODE_ANNOTATION: "queued"}))
+        nc = await env.wait_ready("qr0", timeout=10)
+        assert nc.status_conditions.is_true(INITIALIZED)
+        assert env.cloud.queuedresources.resources["qr0"].state == "ACTIVE"

@@ -1,0 +1,138 @@
+"""Operator runtime: options/env parsing, auth config, logging, servers."""
+
+import json
+import io
+import logging
+
+import pytest
+
+from gpu_provisioner_tpu.auth import (
+    Config, ConfigError, FederatedTokenCredential, StaticTokenCredential,
+    build_config, new_credential,
+)
+from gpu_provisioner_tpu.auth.credentials import MetadataServerCredential
+from gpu_provisioner_tpu.operator.logging import setup_logging
+from gpu_provisioner_tpu.operator.options import parse_feature_gates, parse_options
+
+from .conftest import async_test
+
+
+# --- options ---------------------------------------------------------------
+
+def test_options_env_fallback_and_flags():
+    env = {"METRICS_PORT": "9090", "DISABLE_LEADER_ELECTION": "false",
+           "FEATURE_GATES": "NodeRepair=false", "LAUNCH_TIMEOUT_SECONDS": "600"}
+    o = parse_options(argv=["--health-probe-port", "9091"], env=env)
+    assert o.metrics_port == 9090
+    assert o.health_probe_port == 9091
+    assert o.disable_leader_election is False
+    assert o.feature_gates.node_repair is False
+    assert o.launch_timeout_seconds == 600
+
+
+def test_feature_gate_parsing_tolerates_junk():
+    fg = parse_feature_gates("garbage,,NodeRepair=true,=x",
+                             parse_options(argv=[], env={}).feature_gates)
+    assert fg.node_repair is True
+
+
+# --- auth config (pkg/auth/config_test.go analog) --------------------------
+
+def test_config_parse_trim_validate():
+    cfg = build_config({"PROJECT_ID": " p1 ", "LOCATION": "us-central2-b",
+                        "CLUSTER_NAME": "kaito"})
+    assert cfg.project_id == "p1"
+    assert cfg.deployment_mode == "managed"
+
+
+def test_config_missing_vars_actionable():
+    with pytest.raises(ConfigError) as e:
+        build_config({"PROJECT_ID": "p"})
+    assert "LOCATION" in str(e.value) or "location" in str(e.value)
+
+
+def test_config_self_hosted_requires_token_file():
+    with pytest.raises(ConfigError):
+        build_config({"PROJECT_ID": "p", "LOCATION": "l", "CLUSTER_NAME": "c",
+                      "DEPLOYMENT_MODE": "self-hosted"})
+    cfg = build_config({"PROJECT_ID": "p", "LOCATION": "l", "CLUSTER_NAME": "c",
+                        "DEPLOYMENT_MODE": "self-hosted",
+                        "GOOGLE_FEDERATED_TOKEN_FILE": "/var/run/token"})
+    assert isinstance(new_credential(cfg), FederatedTokenCredential)
+    cfg2 = build_config({"PROJECT_ID": "p", "LOCATION": "l", "CLUSTER_NAME": "c"})
+    assert isinstance(new_credential(cfg2), MetadataServerCredential)
+    from gpu_provisioner_tpu.auth.credentials import ImpersonatedCredential
+    cfg3 = build_config({"PROJECT_ID": "p", "LOCATION": "l", "CLUSTER_NAME": "c",
+                         "DEPLOYMENT_MODE": "self-hosted",
+                         "GOOGLE_FEDERATED_TOKEN_FILE": "/var/run/token",
+                         "GOOGLE_SERVICE_ACCOUNT": "sa@p.iam.gserviceaccount.com"})
+    assert isinstance(new_credential(cfg3), ImpersonatedCredential)
+
+
+@async_test
+async def test_federated_credential_rereads_file(tmp_path):
+    import httpx
+    calls = []
+
+    def handler(request: httpx.Request) -> httpx.Response:
+        calls.append(dict(request.headers))
+        body = dict(pair.split("=", 1) for pair in
+                    request.content.decode().split("&"))
+        return httpx.Response(200, json={"access_token": "tok-" + body[
+            "subject_token"][-1]})
+
+    tf = tmp_path / "token"
+    tf.write_text("jwt1")
+    cred = FederatedTokenCredential(
+        str(tf), "aud", http=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    assert await cred.token() == "tok-1"
+    tf.write_text("jwt2")
+    assert await cred.token() == "tok-1"  # cached within re-read interval
+    cred._at = 0  # age out the cache → file re-read picks up rotation
+    assert await cred.token() == "tok-2"
+
+
+@async_test
+async def test_static_credential():
+    assert await StaticTokenCredential("t").token() == "t"
+
+
+# --- logging ---------------------------------------------------------------
+
+def test_json_logging_shape():
+    buf = io.StringIO()
+    setup_logging("debug", stream=buf)
+    logging.getLogger("x.y").info("hello", extra={"nodeclaim": "ws0"})
+    line = json.loads(buf.getvalue().strip())
+    assert line["level"] == "info" and line["logger"] == "x.y"
+    assert line["msg"] == "hello" and line["nodeclaim"] == "ws0"
+    logging.getLogger().handlers.clear()
+
+
+# --- servers ---------------------------------------------------------------
+
+@async_test
+async def test_metrics_and_health_servers():
+    from aiohttp.test_utils import TestClient, TestServer
+    from gpu_provisioner_tpu.operator.server import build_apps
+    from gpu_provisioner_tpu.runtime import InMemoryClient, Manager
+
+    mgr = Manager(InMemoryClient())
+    metrics_app, health_app = build_apps(mgr, enable_profiling=True)
+
+    async with TestClient(TestServer(health_app)) as hc:
+        r = await hc.get("/healthz")
+        assert r.status == 200
+        r = await hc.get("/readyz")
+        assert r.status == 503  # manager not started
+        await mgr.start()
+        r = await hc.get("/readyz")
+        assert r.status == 200
+        await mgr.stop()
+
+    async with TestClient(TestServer(metrics_app)) as mc:
+        r = await mc.get("/metrics")
+        text = await r.text()
+        assert "karpenter_cloudprovider_duration_seconds" in text
+        r = await mc.get("/debug/tasks")
+        assert r.status == 200
