@@ -42,12 +42,12 @@ class StaticTokenCredential:
 class _CachingCredential:
     def __init__(self):
         self._cached: Optional[str] = None
-        self._at = 0.0
+        self._expires = 0.0
 
     async def token(self) -> str:
-        if self._cached is None or time.monotonic() - self._at > TOKEN_REREAD_INTERVAL:
+        if self._cached is None or time.monotonic() >= self._expires:
             self._cached = await self._fetch()
-            self._at = time.monotonic()
+            self._expires = time.monotonic() + TOKEN_REREAD_INTERVAL
         return self._cached
 
     async def _fetch(self) -> str:

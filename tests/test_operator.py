@@ -88,7 +88,7 @@ async def test_federated_credential_rereads_file(tmp_path):
     assert await cred.token() == "tok-1"
     tf.write_text("jwt2")
     assert await cred.token() == "tok-1"  # cached within re-read interval
-    cred._at = 0  # age out the cache → file re-read picks up rotation
+    cred._expires = 0  # age out the cache → file re-read picks up rotation
     assert await cred.token() == "tok-2"
 
 
