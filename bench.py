@@ -1,0 +1,142 @@
+"""Benchmark: provisioning throughput of the full control plane.
+
+Drives N NodeClaims through the REAL controller set (launch → registration →
+initialization → Ready) against the simulated cloud (envtest), then — when an
+accelerator is attached — times the flagship workload's forward step on it.
+
+Prints ONE JSON line:
+  {"metric": "nodeclaim_ready_p50", "value": <sec>, "unit": "s",
+   "vs_baseline": <value/600>, "extra": {...}}
+
+Baseline semantics: the reference encodes NO published numbers (BASELINE.md);
+its only hard bound on NodeClaim→Ready is the 10-min e2e Eventually timeout
+(reference test/e2e/pkg/environment/common/environment.go:67). vs_baseline is
+p50/600s — lower is better. ``extra`` carries the other BASELINE.json
+headline metrics (reconcile QPS, TPU chips/min) plus workload tokens/s.
+
+Usage: python bench.py [--fast] [--claims N] [--shape tpu-v5e-8] [--no-tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import statistics
+import sys
+import time
+
+BASELINE_READY_BOUND_S = 600.0  # reference e2e Eventually timeout
+
+
+def _p99(samples: list) -> float:
+    s = sorted(samples)
+    return s[min(len(s) - 1, math.ceil(0.99 * len(s)) - 1)]
+
+
+async def bench_provisioning(n_claims: int, shape: str) -> dict:
+    from gpu_provisioner_tpu import catalog
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    opts = EnvtestOptions(create_latency=0.05, node_join_delay=0.02,
+                          node_ready_delay=0.02,
+                          max_concurrent_reconciles=256)
+    resolved = catalog.lookup(shape)
+    if resolved is None:
+        raise SystemExit(f"unknown TPU shape {shape!r} (try tpu-v5e-8, v5p-32)")
+    async with Env(opts) as env:
+
+        async def provision(i: int) -> float:
+            # per-claim latency stamped at actual readiness, not loop arrival
+            t_create = time.perf_counter()
+            await env.client.create(
+                make_nodeclaim(f"bench{i}", shape, workspace=f"ws{i}"))
+            await env.wait_ready(f"bench{i}", timeout=120)
+            return time.perf_counter() - t_create
+
+        t0 = time.perf_counter()
+        readies = await asyncio.gather(*(provision(i) for i in range(n_claims)))
+        elapsed = time.perf_counter() - t0
+    return {
+        "p50_s": statistics.median(readies),
+        "p99_s": _p99(readies),
+        "reconcile_qps": n_claims / elapsed,
+        "chips_per_min": n_claims * resolved.chips / (elapsed / 60.0),
+        "elapsed_s": elapsed,
+        "claims": n_claims,
+    }
+
+
+def bench_workload(fast: bool) -> dict:
+    """Forward-step throughput of the flagship model on the attached device."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+    from gpu_provisioner_tpu.models.train import make_forward
+
+    dev = jax.devices()[0]
+    cfg = (LlamaConfig(vocab_size=2048, dim=512, n_layers=4, n_heads=8,
+                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16")
+           if fast else
+           LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16"))
+    B, S = (4, 512) if fast else (8, 1024)
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    tokens = jax.device_put(jnp.zeros((B, S), jnp.int32), dev)
+    fwd = make_forward(cfg)
+
+    def settle(x):
+        # On tunneled/experimental platforms block_until_ready can return
+        # before execution completes; a scalar host read cannot.
+        x.block_until_ready()
+        return float(x[0, 0, 0])
+
+    for _ in range(3):                               # compile + settle queue
+        settle(fwd(params, tokens))
+    iters = 10
+    best = float("inf")
+    for _ in range(3):                               # best-of-3 against jitter
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(params, tokens)
+        settle(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return {"platform": dev.platform, "tokens_per_s": B * S / best,
+            "step_ms": best * 1e3}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true", help="small sizes (CI/verify)")
+    ap.add_argument("--claims", type=int, default=None)
+    ap.add_argument("--shape", default="tpu-v5e-8")
+    ap.add_argument("--no-tpu", action="store_true",
+                    help="skip the workload timing (control plane only)")
+    args = ap.parse_args(argv)
+    n = args.claims or (16 if args.fast else 64)
+
+    prov = asyncio.run(bench_provisioning(n, args.shape))
+    extra = {k: round(v, 4) if isinstance(v, float) else v
+             for k, v in prov.items() if k != "p50_s"}
+    if not args.no_tpu:
+        try:
+            extra["workload"] = {k: round(v, 2) if isinstance(v, float) else v
+                                 for k, v in bench_workload(args.fast).items()}
+        except Exception as e:  # no usable accelerator — control plane still counts
+            extra["workload_error"] = f"{type(e).__name__}: {e}"
+
+    p50 = prov["p50_s"]
+    print(json.dumps({
+        "metric": "nodeclaim_ready_p50",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(p50 / BASELINE_READY_BOUND_S, 6),
+        "extra": extra,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Flagship workload models for provisioned TPU slices.
+
+The reference provisions GPU VMs for KAITO's LLM workspaces (Llama-family
+pods — BASELINE.json "single-host slice: v5e-8 + Llama-7B pod"); this
+package is the TPU-native equivalent of that workload: a Llama-style
+decoder in pure JAX, sharded over the mesh built from the provisioner's
+topology labels (parallel/topology.py).
+"""
+
+from .llama import LlamaConfig, forward, init_params, param_specs
+
+__all__ = ["LlamaConfig", "init_params", "forward", "param_specs"]

@@ -1,0 +1,186 @@
+"""Llama-family decoder in pure JAX, designed for the MXU.
+
+TPU-first choices (not a torch translation):
+- layers stored **stacked** ([L, ...] leading dim) and executed with
+  ``lax.scan`` — XLA compiles ONE block and reuses it, keeping compile time
+  flat in depth and letting the scheduler pipeline HBM prefetch;
+- bf16 matmuls (MXU-native), fp32 for norms/softmax/logits accumulation;
+- static shapes throughout; causal masking via positions, no dynamic slicing;
+- attention is injected (``attn_fn``) so the same forward runs dense
+  single-chip (ops/attention), ring sequence-parallel (parallel/ring.py
+  under shard_map), or a pallas flash kernel — the sharding lives outside
+  the math;
+- tensor parallelism is expressed only as PartitionSpecs (``param_specs``);
+  XLA/GSPMD inserts the collectives (scaling-book recipe), nothing manual.
+
+Model shapes follow the public Llama family (7B: dim 4096 / 32 layers /
+32 heads / GQA optional); presets sized for bring-up are in PRESETS.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring import dense_attention
+from ..parallel.topology import AXIS_MODEL
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8            # GQA; == n_heads → MHA
+    hidden_dim: int = 11008        # SwiGLU inner width
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"        # activation / matmul dtype
+    param_dtype: str = "float32"   # master weights
+    remat: bool = False            # jax.checkpoint each block (HBM ↔ FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def act_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+PRESETS = {
+    "llama-7b": LlamaConfig(),
+    "llama-1b": LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+                            hidden_dim=5504),
+    "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=128),
+}
+
+
+def init_params(key, cfg: LlamaConfig) -> dict:
+    """Stacked-layer parameter pytree. Truncated-normal-ish scaled init."""
+    pd = jnp.dtype(cfg.param_dtype)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.hidden_dim
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ks = jax.random.split(key, 9)
+
+    def norm(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5)).astype(pd)
+
+    return {
+        "embed": norm(ks[0], (cfg.vocab_size, D), D),
+        "blocks": {
+            "wq": norm(ks[1], (L, D, Hq * Dh), D),
+            "wk": norm(ks[2], (L, D, Hkv * Dh), D),
+            "wv": norm(ks[3], (L, D, Hkv * Dh), D),
+            "wo": norm(ks[4], (L, Hq * Dh, D), Hq * Dh),
+            "w_gate": norm(ks[5], (L, D, F), D),
+            "w_up": norm(ks[6], (L, D, F), D),
+            "w_down": norm(ks[7], (L, F, D), F),
+            "ln_attn": jnp.ones((L, D), pd),
+            "ln_mlp": jnp.ones((L, D), pd),
+        },
+        "ln_final": jnp.ones((D,), pd),
+        "lm_head": norm(ks[8], (D, cfg.vocab_size), D),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs for tensor parallelism over the ``model`` mesh axis.
+
+    Megatron layout expressed declaratively: QKV/gate/up column-parallel,
+    wo/down row-parallel, embedding/lm_head vocab-parallel. The stacked
+    layer dim L is never sharded.
+    """
+    M = AXIS_MODEL
+    return {
+        "embed": P(M, None),
+        "blocks": {
+            "wq": P(None, None, M), "wk": P(None, None, M),
+            "wv": P(None, None, M), "wo": P(None, M, None),
+            "w_gate": P(None, None, M), "w_up": P(None, None, M),
+            "w_down": P(None, M, None),
+            "ln_attn": P(None, None), "ln_mlp": P(None, None),
+        },
+        "ln_final": P(None),
+        "lm_head": P(None, M),
+    }
+
+
+def _rmsnorm(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x: [B, S, H, D], positions: [B, S] or [S]."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    ang = positions[..., None].astype(jnp.float32) * freqs        # [B, S, D/2]
+    cos, sin = jnp.cos(ang)[:, :, None, :], jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _block(x, lp, cfg: LlamaConfig, positions, attn_fn):
+    """One decoder block. x: [B, S, D], lp: this layer's param slice."""
+    B, S, D = x.shape
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ad = cfg.act_dtype
+
+    h = _rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(ad)).reshape(B, S, Hq, Dh)
+    k = (h @ lp["wk"].astype(ad)).reshape(B, S, Hkv, Dh)
+    v = (h @ lp["wv"].astype(ad)).reshape(B, S, Hkv, Dh)
+    q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+    o = attn_fn(q, k, v).reshape(B, S, Hq * Dh)
+    x = x + o @ lp["wo"].astype(ad)
+
+    h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ lp["w_gate"].astype(ad)) * (h @ lp["w_up"].astype(ad))
+    return x + gated @ lp["w_down"].astype(ad)
+
+
+def forward(params: dict, tokens, cfg: LlamaConfig,
+            attn_fn: Optional[Callable] = None,
+            positions=None):
+    """Logits for next-token prediction. tokens: [B, S] int32 → [B, S, V].
+
+    ``attn_fn(q, k, v) -> o`` defaults to dense causal attention; the
+    sequence-parallel train step passes the shard_map-wrapped ring kernel.
+    ``positions`` defaults to arange(S) — pass global positions when the
+    sequence axis is sharded.
+    """
+    if attn_fn is None:
+        attn_fn = dense_attention
+    ad = cfg.act_dtype
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.arange(S, dtype=jnp.int32)
+
+    x = params["embed"].astype(ad)[tokens]                 # [B, S, D]
+
+    blk = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
+    if cfg.remat:
+        blk = jax.checkpoint(blk)
+
+    def scan_body(x, layer_params):
+        return blk(x, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+
+    x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits

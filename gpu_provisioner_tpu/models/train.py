@@ -1,0 +1,98 @@
+"""Sharded training step over the provisioner-derived mesh.
+
+The GSPMD recipe (scaling-book): params carry PartitionSpecs
+(models/llama.py param_specs — tensor parallel over ``model``), the batch is
+sharded over (slice, data) × ``seq``, attention runs as a shard_map'd ring
+kernel over ``seq``, and XLA inserts every collective (psum for row-parallel
+matmuls, all-gathers for the embedding, reduce-scatter in the backward) —
+nothing is hand-scheduled. ``slice`` is the DCN axis: gradients sync across
+slices exactly like data parallelism, which is the multi-slice
+"4× v5e-16 DCN data-parallel" configuration in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.ring import dense_attention, ring_attention
+from ..parallel.topology import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_SLICE
+from .llama import LlamaConfig, forward, init_params, param_specs
+
+BATCH_SPEC = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
+
+
+def default_optimizer():
+    """The one default — make_train_state and make_train_step must agree or
+    opt_state layout and update rules silently diverge."""
+    return optax.adamw(3e-4, weight_decay=0.1)
+
+
+def make_attn_fn(mesh) -> Callable:
+    """Ring attention over ``seq`` when that axis is sharded, else dense."""
+    if mesh.shape[AXIS_SEQ] == 1:
+        return dense_attention
+    qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
+    return jax.shard_map(
+        partial(ring_attention, axis_name=AXIS_SEQ),
+        mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec, check_vma=False)
+
+
+def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None):
+    """Next-token cross entropy. inputs/targets: [B, S] int32 (pre-shifted —
+    both shard cleanly over ``seq``, unlike a fused [B, S+1] array)."""
+    logits = forward(params, inputs, cfg, attn_fn=attn_fn)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def shard_params(params, mesh, cfg: LlamaConfig):
+    """Place a parameter pytree onto the mesh per param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def make_train_state(key, cfg: LlamaConfig, mesh, optimizer=None):
+    """(params, opt_state, optimizer) initialized and sharded on the mesh."""
+    if optimizer is None:
+        optimizer = default_optimizer()
+    params = shard_params(init_params(key, cfg), mesh, cfg)
+    opt_state = jax.jit(optimizer.init)(params)  # inherits param shardings
+    return params, opt_state, optimizer
+
+
+def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
+    """jitted (params, opt_state, inputs, targets) → (params, opt_state, loss).
+
+    inputs/targets: [B, S] int32, sharded BATCH_SPEC. Donates
+    params/opt_state so the update is in-place in HBM.
+    """
+    if optimizer is None:
+        optimizer = default_optimizer()
+    attn_fn = make_attn_fn(mesh)
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, inputs, targets, cfg, attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_forward(cfg: LlamaConfig):
+    """jittable single-device forward (the __graft_entry__ surface)."""
+
+    def fn(params, tokens):
+        return forward(params, tokens, cfg)
+
+    return jax.jit(fn)

@@ -1,0 +1,15 @@
+"""Slice-side parallelism: topology discovery, device meshes, collectives.
+
+The provisioner's job ends at a Ready slice carrying ``tpu.kaito.sh/*``
+labels (SURVEY.md §2c, §5 "distributed communication backend"); this package
+is the workload half of that contract — it turns those labels into a
+``jax.sharding.Mesh`` (ICI within a slice, DCN across slices) and provides
+the sequence-parallel ring attention used by the flagship model.
+"""
+
+from .topology import (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_SLICE,
+                       SliceTopology, make_mesh, mesh_shape_for)
+from .ring import ring_attention
+
+__all__ = ["SliceTopology", "make_mesh", "mesh_shape_for", "ring_attention",
+           "AXIS_SLICE", "AXIS_DATA", "AXIS_SEQ", "AXIS_MODEL"]

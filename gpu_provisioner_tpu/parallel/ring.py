@@ -1,0 +1,114 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context path for the flagship workload (SURVEY.md §5 "long-context /
+sequence parallelism" — absent in the reference; first-class here). Q stays
+put; K/V blocks rotate around the ``seq`` mesh axis via ``lax.ppermute``
+(ICI neighbor exchange), with flash-style running-max/denominator
+accumulation in fp32 so the result is exact regardless of ring order.
+Compute for step i overlaps the collective for step i+1 under XLA's
+latency-hiding scheduler — communication cost ~ O(S/n per step), matching
+the blockwise-parallel formulation in PAPERS.md (Liu et al., ring attention).
+
+Used inside ``shard_map`` (models/train.py); each device sees its local
+[B, S/n, H, D] block. GQA is handled by repeating K/V heads locally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1.0e30  # mask value; finite so exp() underflows instead of NaN-ing
+
+
+def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
+    """One Q-block × KV-block flash partial: returns (o, m, l) in fp32.
+
+    q: [B, Sq, H, D]   k/v: [B, Sk, H, D]   positions: [Sq], [Sk]
+    """
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        mask = q_pos[:, None] >= kv_pos[None, :]          # [Sq, Sk]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                               # [B, H, Sq]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m == NEG_INF → p rows are exp(0)=1 garbage; zero them
+    p = jnp.where((m > NEG_INF / 2)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                               # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   scale: float | None = None):
+    """Exact attention with K/V rotating around ``axis_name``.
+
+    Args (per-device blocks, inside shard_map):
+      q: [B, Sq, Hq, D] — local query block (global seq sharded over axis)
+      k, v: [B, Sk, Hkv, D] — local key/value block
+    Returns [B, Sq, Hq, D] in q.dtype.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sq, Hq, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    if Hq != Hkv:                                          # GQA: repeat KV heads
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    q_pos = my * Sq + jnp.arange(Sq)
+    perm = [(i, (i + 1) % n) for i in range(n)]            # shard i → i+1
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        kv_block = (my - i) % n                            # whose block we hold
+        kv_pos = kv_block * Sk + jnp.arange(Sk)
+        o_i, m_i, l_i = _block_attn(q, k_cur, v_cur, q_pos, kv_pos, scale, causal)
+        m_new = jnp.maximum(m, m_i)
+        c_old = jnp.exp(m - m_new)                         # [B, H, Sq]
+        c_new = jnp.exp(m_i - m_new)
+        l = l * c_old + l_i * c_new
+        o = o * c_old.transpose(0, 2, 1)[..., None] \
+            + o_i * c_new.transpose(0, 2, 1)[..., None]
+        if n > 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_cur, v_cur
+
+    o0 = jnp.zeros((B, Sq, Hq, D), jnp.float32)
+    m0 = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hq, Sq), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+
+    l = l.transpose(0, 2, 1)[..., None]                    # [B, Sq, H, 1]
+    o = o / jnp.where(l > 0, l, 1.0)
+    return o.astype(q.dtype)
+
+
+def dense_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None):
+    """Single-device exact attention (same contract, no mesh axis) — the
+    n=1 specialization used by entry()'s single-chip forward."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    Hq, Hkv = q.shape[2], k.shape[2]
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)

@@ -1,0 +1,214 @@
+"""Topology discovery: provisioner labels → JAX device mesh.
+
+This closes the loop the reference leaves implicit (SURVEY.md §2c): the
+controller stamps ``tpu.kaito.sh/{accelerator,topology,chips,hosts,
+worker-index,slice-group}`` onto nodes (catalog.SliceShape.node_labels), GKE
+projects them into TPU pods, and this module consumes them to bootstrap
+``jax.distributed`` and build the device mesh the training step shards over.
+
+Axis convention (scaling-book ordering — slowest-varying interconnect
+outermost):
+
+    (slice, data, seq, model)
+
+``slice`` spans slices over DCN (multi-slice data parallelism — the
+"N NodeClaims → N slices" configuration in BASELINE.json); ``data``/``seq``/
+``model`` ride ICI within one slice. Batch is sharded over (slice, data),
+sequence over ``seq`` (ring attention), and parameters over ``model``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from math import prod
+from typing import Mapping, Optional, Sequence
+
+from ..apis import labels as wk
+
+AXIS_SLICE = "slice"
+AXIS_DATA = "data"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+MESH_AXES = (AXIS_SLICE, AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+
+# GKE injects these into TPU pods (the downward-API half of the contract;
+# TPU_WORKER_HOSTNAMES is the same variable the Cloud TPU runtime uses).
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+COORDINATOR_PORT = 8476  # jax.distributed default
+
+
+class TopologyError(Exception):
+    """Labels/env describe no usable slice topology."""
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """One worker's view of the slice(s) it belongs to.
+
+    Mirrors what the provisioner wrote at Create time
+    (providers/instance.py → catalog.SliceShape.node_labels) plus the
+    per-worker identity GKE adds.
+    """
+
+    generation: str           # "v5e" | "v5p" | ...
+    topology: str             # ICI topology, e.g. "2x4" / "2x2x4"
+    chips: int                # chips in THIS slice
+    hosts: int                # worker VMs in this slice
+    worker_index: int = 0     # this host's index within the slice
+    worker_hostnames: tuple[str, ...] = ()
+    num_slices: int = 1       # DCN-connected slices (multi-slice DP)
+    slice_index: int = 0      # which slice this worker's node pool is
+    slice_group: str = ""     # tpu.kaito.sh/slice-group value
+    coordinator: str = ""     # global coordinator override (multi-slice)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // max(1, self.hosts)
+
+    @property
+    def ici_dims(self) -> tuple[int, ...]:
+        return tuple(int(d) for d in self.topology.split("x"))
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.num_slices
+
+    def coordinator_address(self) -> str:
+        """Where jax.distributed's coordinator runs: the explicit override
+        when set (required for multi-slice, where each slice only knows its
+        own hostnames), else host 0 of this slice."""
+        if self.coordinator:
+            addr = self.coordinator
+            return addr if ":" in addr else f"{addr}:{COORDINATOR_PORT}"
+        if self.num_slices > 1:
+            raise TopologyError(
+                "multi-slice topology needs an explicit coordinator "
+                "(slice-local hostnames can't name the global host 0) — "
+                "set TPU_KAITO_COORDINATOR / SliceTopology.coordinator")
+        if self.worker_hostnames:
+            return f"{self.worker_hostnames[0]}:{COORDINATOR_PORT}"
+        return f"localhost:{COORDINATOR_PORT}"
+
+    def distributed_init_args(self) -> dict:
+        """kwargs for ``jax.distributed.initialize``; process ids are
+        globally unique across slices (slice-major ordering)."""
+        return {
+            "coordinator_address": self.coordinator_address(),
+            "num_processes": self.hosts * self.num_slices,
+            "process_id": self.slice_index * self.hosts + self.worker_index,
+        }
+
+    @classmethod
+    def from_node_labels(cls, labels: Mapping[str, str],
+                         environ: Optional[Mapping[str, str]] = None,
+                         num_slices: int = 1) -> "SliceTopology":
+        """Build from the ``tpu.kaito.sh/*`` labels the provisioner stamped.
+
+        ``environ`` supplies the per-worker identity (worker id/hostnames)
+        that labels cannot carry pod-portably.
+        """
+        env = environ if environ is not None else os.environ
+        try:
+            generation = labels[wk.TPU_ACCELERATOR_LABEL]
+            topology = labels[wk.TPU_TOPOLOGY_LABEL]
+            chips = int(labels[wk.TPU_CHIPS_LABEL])
+            hosts = int(labels[wk.TPU_HOSTS_LABEL])
+            worker = int(labels.get(wk.TPU_WORKER_INDEX_LABEL,
+                                    env.get(ENV_WORKER_ID, "0")))
+            slice_index = int(env.get("TPU_KAITO_SLICE_INDEX", "0"))
+        except KeyError as e:
+            raise TopologyError(
+                f"node labels missing {e.args[0]!r} — was this node "
+                f"provisioned by tpu-provisioner? (have: {sorted(labels)})")
+        except ValueError as e:
+            raise TopologyError(f"non-integer topology label/env value: {e}")
+        hostnames = tuple(h for h in env.get(ENV_WORKER_HOSTNAMES, "").split(",") if h)
+        return cls(generation=generation, topology=topology, chips=chips,
+                   hosts=hosts, worker_index=worker,
+                   worker_hostnames=hostnames, num_slices=num_slices,
+                   slice_index=slice_index,
+                   slice_group=labels.get(wk.TPU_SLICE_GROUP_LABEL, ""),
+                   coordinator=env.get("TPU_KAITO_COORDINATOR", ""))
+
+    @classmethod
+    def from_env(cls, environ: Optional[Mapping[str, str]] = None) -> "SliceTopology":
+        """Build from env alone (labels projected via downward API as
+        ``TPU_KAITO_<NAME>`` variables, the chart's pod-spec convention)."""
+        env = environ if environ is not None else os.environ
+        labels = {
+            wk.TPU_ACCELERATOR_LABEL: env.get("TPU_KAITO_ACCELERATOR", ""),
+            wk.TPU_TOPOLOGY_LABEL: env.get("TPU_KAITO_TOPOLOGY", ""),
+            wk.TPU_CHIPS_LABEL: env.get("TPU_KAITO_CHIPS", ""),
+            wk.TPU_HOSTS_LABEL: env.get("TPU_KAITO_HOSTS", ""),
+        }
+        labels = {k: v for k, v in labels.items() if v}
+        try:
+            num_slices = int(env.get("TPU_KAITO_NUM_SLICES", "1"))
+        except ValueError as e:
+            raise TopologyError(f"non-integer TPU_KAITO_NUM_SLICES: {e}")
+        return cls.from_node_labels(labels, environ=env, num_slices=num_slices)
+
+
+def mesh_shape_for(n_devices: int, *, num_slices: int = 1,
+                   sp: int = 1, tp: int = 1,
+                   dp: Optional[int] = None) -> tuple[int, int, int, int]:
+    """Factor ``n_devices`` into the (slice, data, seq, model) mesh shape.
+
+    ``dp`` defaults to whatever is left after slice/seq/model are taken.
+    Raises TopologyError on non-divisibility so a bad deployment config
+    fails at mesh build, not as a cryptic XLA reshape error.
+    """
+    if n_devices % num_slices:
+        raise TopologyError(f"{n_devices} devices not divisible by "
+                            f"num_slices={num_slices}")
+    per_slice = n_devices // num_slices
+    if per_slice % (sp * tp):
+        raise TopologyError(f"{per_slice} devices/slice not divisible by "
+                            f"sp*tp={sp}*{tp}")
+    inferred = per_slice // (sp * tp)
+    if dp is None:
+        dp = inferred
+    elif dp != inferred:
+        raise TopologyError(f"dp={dp} inconsistent: {num_slices}sl×{dp}dp×"
+                            f"{sp}sp×{tp}tp != {n_devices} devices")
+    return (num_slices, dp, sp, tp)
+
+
+def make_mesh(n_devices: Optional[int] = None, *, num_slices: int = 1,
+              sp: int = 1, tp: int = 1, dp: Optional[int] = None,
+              devices: Optional[Sequence] = None):
+    """Build the (slice, data, seq, model) ``jax.sharding.Mesh``.
+
+    Uses ``mesh_utils.create_device_mesh`` for ICI-aware device ordering on
+    real TPU topologies, falling back to a plain reshape (CPU meshes, odd
+    factorizations). Import of jax is deferred so control-plane-only
+    deployments never pay for it.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = list(devices)[:n_devices]
+    shape = mesh_shape_for(n_devices, num_slices=num_slices, sp=sp, tp=tp, dp=dp)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices))
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_from_topology(topo: SliceTopology, *, sp: int = 1, tp: int = 1,
+                       devices: Optional[Sequence] = None):
+    """Mesh for a discovered slice topology: ``slice`` axis = num_slices,
+    remaining chips split dp × sp × tp."""
+    return make_mesh(topo.total_chips if devices is None else None,
+                     num_slices=topo.num_slices, sp=sp, tp=tp,
+                     devices=devices)

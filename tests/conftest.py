@@ -16,6 +16,14 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Site hooks (axon register) may override jax_platforms at interpreter start,
+# which silently ignores the env var above — force the config directly.
+try:
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
 import asyncio
 import functools
 

@@ -1,0 +1,191 @@
+"""Workload-side tests: topology discovery, mesh, ring attention, train step.
+
+Runs on the 8-device virtual CPU mesh (conftest). This is the slice-side
+half of the provisioner contract — labels stamped by the controller
+(catalog.SliceShape.node_labels) must round-trip into a working sharded
+training step (SURVEY.md §2c).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gpu_provisioner_tpu import catalog
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.models.llama import PRESETS, forward, init_params, param_specs
+from gpu_provisioner_tpu.models.train import (BATCH_SPEC, make_attn_fn,
+                                              make_train_state, make_train_step)
+from gpu_provisioner_tpu.parallel import make_mesh
+from gpu_provisioner_tpu.parallel.ring import dense_attention, ring_attention
+from gpu_provisioner_tpu.parallel.topology import (MESH_AXES, SliceTopology,
+                                                   TopologyError, mesh_shape_for)
+
+CFG = PRESETS["tiny"]
+
+
+# --- topology discovery ----------------------------------------------------
+
+def test_topology_from_catalog_labels():
+    """The labels the provisioner stamps resolve back into a topology."""
+    shape = catalog.lookup("v5p-32")
+    labels = shape.node_labels(slice_id="pool0")
+    labels[wk.TPU_WORKER_INDEX_LABEL] = "2"
+    topo = SliceTopology.from_node_labels(labels, environ={})
+    assert (topo.generation, topo.topology) == ("v5p", "2x2x4")
+    assert (topo.chips, topo.hosts, topo.worker_index) == (16, 4, 2)
+    assert topo.chips_per_host == 4
+    assert topo.ici_dims == (2, 2, 4)
+
+
+def test_topology_missing_labels_error_names_key():
+    with pytest.raises(TopologyError, match="tpu.kaito.sh/accelerator"):
+        SliceTopology.from_node_labels({}, environ={})
+
+
+def test_topology_from_env_and_distributed_args():
+    env = {"TPU_KAITO_ACCELERATOR": "v5e", "TPU_KAITO_TOPOLOGY": "4x4",
+           "TPU_KAITO_CHIPS": "16", "TPU_KAITO_HOSTS": "2",
+           "TPU_WORKER_ID": "1", "TPU_WORKER_HOSTNAMES": "h0,h1",
+           "TPU_KAITO_NUM_SLICES": "4", "TPU_KAITO_SLICE_INDEX": "2",
+           "TPU_KAITO_COORDINATOR": "slice0-h0"}
+    topo = SliceTopology.from_env(env)
+    assert topo.worker_index == 1 and topo.num_slices == 4
+    assert topo.total_chips == 64
+    args = topo.distributed_init_args()
+    # process ids globally unique across slices: slice 2 of 4, worker 1 of 2
+    assert args == {"coordinator_address": "slice0-h0:8476",
+                    "num_processes": 8, "process_id": 5}
+
+
+def test_topology_multislice_requires_coordinator():
+    topo = SliceTopology(generation="v5e", topology="4x4", chips=16, hosts=2,
+                         worker_hostnames=("h0", "h1"), num_slices=2)
+    with pytest.raises(TopologyError, match="coordinator"):
+        topo.coordinator_address()
+    # single slice: slice-local host 0 is the coordinator
+    one = SliceTopology(generation="v5e", topology="4x4", chips=16, hosts=2,
+                        worker_hostnames=("h0", "h1"))
+    assert one.coordinator_address() == "h0:8476"
+
+
+def test_topology_bad_label_value_is_topology_error():
+    labels = {wk.TPU_ACCELERATOR_LABEL: "v5e", wk.TPU_TOPOLOGY_LABEL: "2x4",
+              wk.TPU_CHIPS_LABEL: "eight", wk.TPU_HOSTS_LABEL: "1"}
+    with pytest.raises(TopologyError, match="non-integer"):
+        SliceTopology.from_node_labels(labels, environ={})
+
+
+def test_mesh_shape_factoring():
+    assert mesh_shape_for(8, sp=2, tp=2) == (1, 2, 2, 2)
+    assert mesh_shape_for(16, num_slices=2, tp=4) == (2, 2, 1, 4)
+    with pytest.raises(TopologyError, match="not divisible"):
+        mesh_shape_for(8, sp=3)
+    with pytest.raises(TopologyError, match="inconsistent"):
+        mesh_shape_for(8, sp=2, tp=2, dp=4)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(8, sp=2, tp=2)
+    assert mesh.axis_names == MESH_AXES
+    assert dict(mesh.shape) == {"slice": 1, "data": 2, "seq": 2, "model": 2}
+
+
+# --- ring attention --------------------------------------------------------
+
+def _ring_on_mesh(q, k, v, mesh, **kw):
+    spec = P(None, "seq", None, None)
+    fn = jax.jit(jax.shard_map(
+        partial(ring_attention, axis_name="seq", **kw), mesh=mesh,
+        in_specs=(spec,) * 3, out_specs=spec, check_vma=False))
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))
+    return fn(put(q), put(k), put(v))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [4, 2])  # MHA and GQA
+def test_ring_matches_dense_fp32(causal, kv_heads):
+    mesh = make_mesh(8, sp=8)
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (2, 64, 4, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, kv_heads, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, kv_heads, 16), jnp.float32)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = _ring_on_mesh(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_single_shard_degenerates_to_dense():
+    mesh = make_mesh(8, sp=1, tp=1)  # seq axis size 1 → ring of length 1
+    assert make_attn_fn(mesh) is dense_attention
+
+
+# --- model + train step ----------------------------------------------------
+
+def test_param_specs_cover_params():
+    params = init_params(jax.random.key(0), CFG)
+    specs = param_specs(CFG)
+    # identical tree structure, and every spec's rank matches its array
+    jax.tree.map(lambda a, s: None, params, specs)
+    flat_p = jax.tree.leaves_with_path(params)
+    flat_s = dict(jax.tree.leaves_with_path(specs))
+    for path, arr in flat_p:
+        assert len(flat_s[tuple(path)]) <= arr.ndim
+
+
+def test_forward_shapes_and_dtype():
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = forward(params, tokens, CFG)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_forward_causality():
+    """Changing a future token must not change past logits."""
+    params = init_params(jax.random.key(0), CFG)
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 10].set(7)
+    l1 = forward(params, t1, CFG)
+    l2 = forward(params, t2, CFG)
+    np.testing.assert_allclose(np.asarray(l1[0, :10]), np.asarray(l2[0, :10]),
+                               atol=1e-5)
+    assert float(jnp.max(jnp.abs(l1[0, 10:] - l2[0, 10:]))) > 1e-4
+
+
+@pytest.mark.parametrize("sp,tp", [(1, 1), (2, 2), (4, 2)])
+def test_train_step_loss_decreases(sp, tp):
+    mesh = make_mesh(8, sp=sp, tp=tp)
+    params, opt_state, opt = make_train_state(jax.random.key(0), CFG, mesh)
+    step = make_train_step(mesh, CFG, opt)
+    toks = jax.random.randint(jax.random.key(1), (8, 65), 0, CFG.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    inp, tgt = put(toks[:, :-1]), put(toks[:, 1:])
+    params, opt_state, loss0 = step(params, opt_state, inp, tgt)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, inp, tgt)
+    assert jnp.isfinite(loss0) and float(loss) < float(loss0)
+
+
+def test_train_step_multislice_mesh():
+    """DCN axis: 2 slices × (dp=2, tp=2) — the multi-slice DP config."""
+    mesh = make_mesh(8, num_slices=2, tp=2)
+    params, opt_state, opt = make_train_state(jax.random.key(0), CFG, mesh)
+    step = make_train_step(mesh, CFG, opt)
+    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    _, _, loss = step(params, opt_state, put(toks[:, :-1]), put(toks[:, 1:]))
+    assert jnp.isfinite(loss)
+
+
+def test_remat_matches_no_remat():
+    from dataclasses import replace
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jnp.ones((1, 8), jnp.int32)
+    l1 = forward(params, tokens, CFG)
+    l2 = forward(params, tokens, replace(CFG, remat=True))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
