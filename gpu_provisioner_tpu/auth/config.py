@@ -27,6 +27,11 @@ class Config:
     federated_token_file: str = ""     # workload-identity projected token
     service_account_email: str = ""
     e2e_test_mode: bool = False        # reroutes endpoints (azure_client.go:95-100)
+    # e2e reroute targets + credential (cred.go:137-153's KeyVault-cert analog
+    # is a pre-issued static token here). Empty → production endpoints.
+    gke_api_endpoint: str = ""
+    tpu_api_endpoint: str = ""
+    e2e_static_token: str = ""
 
     BASE_VARS: tuple[str, ...] = field(default=(
         "PROJECT_ID", "LOCATION", "CLUSTER_NAME"), repr=False)
@@ -60,6 +65,9 @@ def build_config(env: dict[str, str] | None = None) -> Config:
         federated_token_file=e.get("GOOGLE_FEDERATED_TOKEN_FILE", "").strip(),
         service_account_email=e.get("GOOGLE_SERVICE_ACCOUNT", "").strip(),
         e2e_test_mode=e.get("E2E_TEST_MODE", "").strip().lower() == "true",
+        gke_api_endpoint=e.get("GKE_API_ENDPOINT", "").strip(),
+        tpu_api_endpoint=e.get("TPU_API_ENDPOINT", "").strip(),
+        e2e_static_token=e.get("E2E_STATIC_TOKEN", "").strip(),
     )
     cfg.validate()
     return cfg

@@ -120,7 +120,10 @@ class ImpersonatedCredential(_CachingCredential):
 
 
 def new_credential(cfg) -> Credentials:
-    """Credential selection by deployment mode (azure_client.go:78-89)."""
+    """Credential selection by deployment mode (azure_client.go:78-89);
+    e2e mode short-circuits to a pre-issued token (cred.go:137-153)."""
+    if getattr(cfg, "e2e_test_mode", False):
+        return StaticTokenCredential(cfg.e2e_static_token or "e2e-token")
     if cfg.deployment_mode == "managed":
         return MetadataServerCredential()
     audience = (f"//iam.googleapis.com/projects/{cfg.project_id}/"

@@ -88,16 +88,99 @@ async def _log_transitions(env: Env) -> None:
         w.close()
 
 
-def run_real(opts) -> int:
-    # Assembling against a real GKE cluster needs the REST-backed kube client
-    # + GCP clients (providers/rest.py) and in-cluster credentials; that path
-    # is exercised by the e2e suite against a live cluster, not from here
-    # without one.
-    print("error: no kubeconfig/cluster available in this environment; "
-          "run with --simulate (in-process simulated cloud), or deploy the "
-          "Helm chart (charts/tpu-provisioner) on a GKE cluster.",
-          file=sys.stderr)
-    return 2
+async def run_real(opts) -> int:
+    """Assemble against a real cluster (cmd/controller/main.go:34-59 analog):
+    config from env → credentials → GKE/CloudTPU clients → instance provider
+    → metrics-decorated cloud provider → controller set → manager."""
+    import signal
+
+    from ..apis.core import Node
+    from ..auth.config import ConfigError, build_config
+    from ..auth.credentials import new_credential
+    from ..cloudprovider import MetricsDecorator, TPUCloudProvider
+    from ..controllers.gc import GCOptions
+    from ..controllers.lifecycle import LifecycleOptions
+    from ..controllers.registry import build_controllers
+    from ..providers.instance import InstanceProvider
+    from ..providers.rest import CloudTPUQueuedResourcesClient, GKENodePoolsClient
+    from ..runtime import Manager
+    from ..runtime.events import Recorder
+    from ..runtime.rest import KubeConnection, RestClient
+
+    try:
+        cfg = build_config()
+        cfg.validate()
+    except ConfigError as e:
+        # fail fast with an actionable message (pkg/operator/operator.go:46)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        conn = KubeConnection.in_cluster()
+    except Exception:
+        try:
+            conn = KubeConnection.from_kubeconfig()
+        except Exception as e:
+            print(f"error: no in-cluster service account and no usable "
+                  f"kubeconfig: {e}", file=sys.stderr)
+            return 2
+    kube = RestClient(conn)
+    kube.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
+
+    from ..providers import rest as gcprest
+
+    cred = new_credential(cfg)
+    nodepools = GKENodePoolsClient(
+        cred, cfg.project_id, cfg.location, cfg.cluster_name,
+        endpoint=cfg.gke_api_endpoint or gcprest.GKE_ENDPOINT)
+    queued = CloudTPUQueuedResourcesClient(
+        cred, cfg.project_id, cfg.location,
+        endpoint=cfg.tpu_api_endpoint or gcprest.TPU_ENDPOINT)
+    provider = InstanceProvider(nodepools, kube, queued=queued)
+    cloudprovider = MetricsDecorator(TPUCloudProvider(provider))
+
+    from ..controllers.termination import TerminationOptions
+
+    lifecycle = LifecycleOptions(
+        liveness_enabled=opts.liveness_enabled,
+        launch_timeout=opts.launch_timeout_seconds,
+        registration_timeout=opts.registration_timeout_seconds,
+        termination_requeue=opts.termination_requeue_seconds)
+    controllers, eviction = build_controllers(
+        kube, cloudprovider, Recorder(kube),
+        lifecycle_options=lifecycle,
+        termination_options=TerminationOptions(
+            instance_requeue=opts.instance_requeue_seconds),
+        gc_options=GCOptions(interval=opts.gc_interval_seconds,
+                             leak_grace=opts.gc_leak_grace_seconds),
+        max_concurrent_reconciles=opts.max_concurrent_reconciles,
+        node_repair=opts.feature_gates.node_repair)
+    manager = Manager(kube).register(*controllers)
+
+    eviction.start()
+    await manager.start()
+    runners = await start_servers(manager, opts.metrics_port,
+                                  opts.health_probe_port,
+                                  opts.enable_profiling)
+    log.info("operator up", extra={"project": cfg.project_id,
+                                   "location": cfg.location,
+                                   "cluster": cfg.cluster_name})
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix
+            pass
+    try:
+        await stop.wait()
+    finally:
+        await manager.stop()
+        await eviction.stop()
+        for r in runners:
+            await r.cleanup()
+        await kube.aclose()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -105,7 +188,7 @@ def main(argv=None) -> int:
     setup_logging(opts.log_level)
     if opts.simulate:
         return asyncio.run(run_simulate(opts))
-    return run_real(opts)
+    return asyncio.run(run_real(opts))
 
 
 if __name__ == "__main__":

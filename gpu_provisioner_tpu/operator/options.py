@@ -32,6 +32,8 @@ class Options:
     registration_timeout_seconds: float = 2400.0
     gc_interval_seconds: float = 120.0
     gc_leak_grace_seconds: float = 30.0
+    termination_requeue_seconds: float = 5.0   # lifecycle controller.go:246
+    instance_requeue_seconds: float = 5.0      # node termination await-instance
     max_concurrent_reconciles: int = 64
     simulate: bool = False
     simulate_claims: int = 0
@@ -68,6 +70,10 @@ def parse_options(argv=None, env=None) -> Options:
         registration_timeout_seconds=float(e.get("REGISTRATION_TIMEOUT_SECONDS", "2400")),
         gc_interval_seconds=float(e.get("GC_INTERVAL_SECONDS", "120")),
         gc_leak_grace_seconds=float(e.get("GC_LEAK_GRACE_SECONDS", "30")),
+        termination_requeue_seconds=float(
+            e.get("TERMINATION_REQUEUE_SECONDS", "5")),
+        instance_requeue_seconds=float(
+            e.get("INSTANCE_REQUEUE_SECONDS", "5")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)

@@ -18,6 +18,9 @@ from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
 
 from ..apis.karpenter import NodeClaim
 from ..apis.meta import _KINDS
+# imported for its side effect: registers the karpenter_cloudprovider_*
+# metric families so /metrics always exposes them, whatever the import order
+from ..cloudprovider import metrics as _cloudprovider_metrics  # noqa: F401
 from ..runtime.controller import Manager
 
 

@@ -1,0 +1,263 @@
+"""REST clients for the NodePoolsAPI / QueuedResourcesAPI seams.
+
+The production half of the seam the fakes implement in tests — the analog of
+the reference's azcore-generated AgentPools client behind its 4-method
+interface (azure_client.go:42-47,102-111). Hand-built over httpx because no
+GCP SDK ships in this image and the wire format is plain JSON; the
+translation between our seam models (providers/gcp.py) and the
+container/v1 + tpu/v2 payload shapes lives HERE so the rest of the tree
+never sees wire dicts.
+
+Endpoints (overridable for e2e staging — azure_client.go:95-100 analog):
+  GKE       https://container.googleapis.com/v1/projects/{p}/locations/{l}/
+            clusters/{c}/nodePools[...]
+  Cloud TPU https://tpu.googleapis.com/v2/projects/{p}/locations/{l}/
+            queuedResources[...]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import replace
+from typing import Optional
+
+import httpx
+
+from ..auth.credentials import Credentials
+from ..transport import (GCP_RETRYABLE_STATUS, TransportOptions,
+                         build_http_client, request_with_retries)
+from .gcp import (APIError, NodePool, Operation, QueuedResource,
+                  QueuedResourcesAPI, QR_ACCEPTED)
+
+log = logging.getLogger("gcp.rest")
+
+GKE_ENDPOINT = "https://container.googleapis.com/v1"
+TPU_ENDPOINT = "https://tpu.googleapis.com/v2"
+DEFAULT_TPU_RUNTIME = "tpu-ubuntu2204-base"
+OP_POLL_INTERVAL = 2.0
+
+
+class _AuthedREST:
+    def __init__(self, cred: Credentials, endpoint: str,
+                 transport: Optional[TransportOptions] = None,
+                 http: Optional[httpx.AsyncClient] = None):
+        self.cred = cred
+        self.endpoint = endpoint.rstrip("/")
+        self.topts = transport or TransportOptions()
+        if 429 in self.topts.retryable_status:
+            # 429 here means stockout/quota — a lifecycle answer, not jitter
+            self.topts = replace(self.topts,
+                                 retryable_status=GCP_RETRYABLE_STATUS)
+        self.http = http or build_http_client(self.topts)
+
+    async def req(self, method: str, path: str, **kw) -> dict:
+        headers = {"Authorization": f"Bearer {await self.cred.token()}",
+                   "Content-Type": "application/json"}
+        resp = await request_with_retries(
+            self.http, method, f"{self.endpoint}{path}", opts=self.topts,
+            headers=headers, **kw)
+        if resp.status_code >= 400:
+            raise APIError(resp.text[:512], code=resp.status_code)
+        return resp.json() if resp.content else {}
+
+
+class RESTOperation:
+    """GCP LRO handle: polls ``GET {ops_path}/{name}`` until DONE, then
+    resolves via ``fetch_result`` (the created/deleted resource)."""
+
+    def __init__(self, rest: _AuthedREST, ops_path: str, op: dict,
+                 fetch_result=None):
+        self.rest = rest
+        self.ops_path = ops_path
+        self.op = op
+        self.fetch_result = fetch_result
+
+    async def done(self) -> bool:
+        if self.op.get("status") == "DONE":
+            return True
+        name = self.op.get("name", "")
+        self.op = await self.rest.req("GET", f"{self.ops_path}/{name}")
+        return self.op.get("status") == "DONE"
+
+    # google.rpc.Status integer codes → HTTP-ish taxonomy codes. A real
+    # container/v1 Operation.error carries the INT code; string enum names
+    # are accepted too for robustness.
+    _GRPC_TO_HTTP = {5: 404, 6: 409, 8: 429,
+                     "NOT_FOUND": 404, "ALREADY_EXISTS": 409,
+                     "RESOURCE_EXHAUSTED": 429}
+
+    async def result(self):
+        err = self.op.get("error")
+        if err:
+            # stockouts surface as operation errors with RESOURCE_EXHAUSTED
+            key = err.get("code", err.get("status", ""))
+            code = self._GRPC_TO_HTTP.get(key, 500)
+            raise APIError(err.get("message", str(err)), code=code)
+        if self.fetch_result is not None:
+            return await self.fetch_result()
+        return None
+
+
+class GKENodePoolsClient:
+    """NodePoolsAPI over container.googleapis.com (container/v1)."""
+
+    def __init__(self, cred: Credentials, project: str, location: str,
+                 cluster: str, endpoint: str = GKE_ENDPOINT,
+                 transport: Optional[TransportOptions] = None,
+                 http: Optional[httpx.AsyncClient] = None):
+        self.rest = _AuthedREST(cred, endpoint, transport, http)
+        self.parent = (f"/projects/{project}/locations/{location}"
+                       f"/clusters/{cluster}")
+        self.ops_path = f"/projects/{project}/locations/{location}/operations"
+
+    # --- seam ↔ wire translation ------------------------------------------
+
+    def _to_wire(self, pool: NodePool) -> dict:
+        cfg = pool.config
+        wire_cfg: dict = {"machineType": cfg.machine_type,
+                          "labels": dict(cfg.labels)}
+        if cfg.disk_size_gb:
+            wire_cfg["diskSizeGb"] = cfg.disk_size_gb
+        if cfg.taints:
+            wire_cfg["taints"] = [dict(t) for t in cfg.taints]
+        if cfg.spot:
+            wire_cfg["spot"] = True
+        if cfg.image_type:
+            wire_cfg["imageType"] = cfg.image_type
+        if cfg.reservation:
+            wire_cfg["reservationAffinity"] = {
+                "consumeReservationType": "SPECIFIC_RESERVATION",
+                "key": "compute.googleapis.com/reservation-name",
+                "values": [cfg.reservation]}
+        wire: dict = {"name": pool.name, "config": wire_cfg,
+                      "initialNodeCount": pool.initial_node_count}
+        if pool.placement_policy is not None:
+            pp: dict = {"type": pool.placement_policy.type}
+            if pool.placement_policy.tpu_topology:
+                pp["tpuTopology"] = pool.placement_policy.tpu_topology
+            wire["placementPolicy"] = pp
+        return wire
+
+    def _from_wire(self, d: dict) -> NodePool:
+        cfg = d.get("config", {})
+        ra = cfg.get("reservationAffinity", {})
+        pool = NodePool.from_dict({
+            "name": d.get("name", ""),
+            "config": {
+                "machineType": cfg.get("machineType", ""),
+                "diskSizeGb": cfg.get("diskSizeGb", 0),
+                "labels": cfg.get("labels", {}) or {},
+                "taints": cfg.get("taints", []) or [],
+                "spot": cfg.get("spot", False),
+                "imageType": cfg.get("imageType", ""),
+                "reservation": (ra.get("values") or [""])[0],
+            },
+            "initialNodeCount": d.get("initialNodeCount", 0),
+            "placementPolicy": (
+                {"type": d["placementPolicy"].get("type", "COMPACT"),
+                 "tpuTopology": d["placementPolicy"].get("tpuTopology", "")}
+                if "placementPolicy" in d else None),
+            "status": d.get("status", ""),
+            "statusMessage": d.get("statusMessage", ""),
+        })
+        return pool
+
+    # --- NodePoolsAPI ------------------------------------------------------
+
+    async def begin_create(self, pool: NodePool) -> Operation:
+        op = await self.rest.req("POST", f"{self.parent}/nodePools",
+                                 json={"nodePool": self._to_wire(pool)})
+
+        async def fetch():
+            return await self.get(pool.name)
+
+        return RESTOperation(self.rest, self.ops_path, op, fetch)
+
+    async def get(self, name: str) -> NodePool:
+        d = await self.rest.req("GET", f"{self.parent}/nodePools/{name}")
+        return self._from_wire(d)
+
+    async def begin_delete(self, name: str) -> Operation:
+        op = await self.rest.req("DELETE", f"{self.parent}/nodePools/{name}")
+        return RESTOperation(self.rest, self.ops_path, op)
+
+    async def list(self) -> list[NodePool]:
+        d = await self.rest.req("GET", f"{self.parent}/nodePools")
+        return [self._from_wire(p) for p in d.get("nodePools", [])]
+
+
+class CloudTPUQueuedResourcesClient:
+    """QueuedResourcesAPI over tpu.googleapis.com (tpu/v2).
+
+    The creation LRO for a queued resource completes fast (it only enqueues);
+    the interesting state machine (WAITING_FOR_RESOURCES → ... → ACTIVE)
+    lives on the resource itself, which is why the seam returns the resource
+    rather than an Operation (SURVEY.md §7 hard part 2: poll the QR
+    asynchronously, never block a reconcile worker on it).
+    """
+
+    def __init__(self, cred: Credentials, project: str, location: str,
+                 endpoint: str = TPU_ENDPOINT,
+                 runtime_version: str = DEFAULT_TPU_RUNTIME,
+                 transport: Optional[TransportOptions] = None,
+                 http: Optional[httpx.AsyncClient] = None):
+        self.rest = _AuthedREST(cred, endpoint, transport, http)
+        self.parent = f"/projects/{project}/locations/{location}"
+        self.runtime_version = runtime_version
+
+    def _to_wire(self, qr: QueuedResource) -> dict:
+        node: dict = {
+            "acceleratorType": qr.accelerator_type,
+            "runtimeVersion": qr.runtime_version or self.runtime_version,
+        }
+        if qr.spot:
+            node["schedulingConfig"] = {"spot": True}
+        wire: dict = {"tpu": {"nodeSpec": [{
+            "parent": self.parent.lstrip("/"),
+            "nodeId": qr.node_pool or qr.name,
+            "node": node,
+        }]}}
+        if qr.reservation:
+            wire["reservationName"] = qr.reservation
+            wire["guaranteed"] = {"reserved": True}
+        return wire
+
+    def _from_wire(self, d: dict) -> QueuedResource:
+        spec = (d.get("tpu", {}).get("nodeSpec") or [{}])[0]
+        node = spec.get("node", {})
+        return QueuedResource(
+            name=d.get("name", "").rsplit("/", 1)[-1],
+            accelerator_type=node.get("acceleratorType", ""),
+            runtime_version=node.get("runtimeVersion", ""),
+            state=d.get("state", {}).get("state", QR_ACCEPTED),
+            state_message=str(d.get("state", {}).get("stateInitiator", "")),
+            node_pool=spec.get("nodeId", ""),
+            reservation=d.get("reservationName", ""),
+            spot=bool(node.get("schedulingConfig", {}).get("spot", False)))
+
+    async def create(self, qr: QueuedResource) -> QueuedResource:
+        await self.rest.req("POST", f"{self.parent}/queuedResources",
+                            params={"queuedResourceId": qr.name},
+                            json=self._to_wire(qr))
+        # enqueue-LRO races the first GET occasionally; brief retry
+        for attempt in range(5):
+            try:
+                return await self.get(qr.name)
+            except APIError as e:
+                if not e.not_found or attempt == 4:
+                    raise
+                await asyncio.sleep(0.5 * (attempt + 1))
+        raise AssertionError("unreachable")
+
+    async def get(self, name: str) -> QueuedResource:
+        d = await self.rest.req("GET", f"{self.parent}/queuedResources/{name}")
+        return self._from_wire(d)
+
+    async def delete(self, name: str) -> None:
+        await self.rest.req("DELETE", f"{self.parent}/queuedResources/{name}",
+                            params={"force": "true"})
+
+    async def list(self) -> list[QueuedResource]:
+        d = await self.rest.req("GET", f"{self.parent}/queuedResources")
+        return [self._from_wire(q) for q in d.get("queuedResources", [])]

@@ -1,0 +1,328 @@
+"""REST-backed kube Client: the production implementation of the Client seam.
+
+The reference gets this from controller-runtime (cached client + informers);
+here it is a deliberate informer-lite: ``watch()`` does ListAndWatch with
+automatic re-list on stream breakage, matching InMemoryClient's replay
+semantics (runtime/store.py:69-82), and reads are direct (no cache) — the
+controller set's QPS is bounded by the workqueue, not list fan-out, at the
+scales this provisioner serves (one NodeClaim per KAITO workspace).
+
+Auth: in-cluster service-account token (projected, re-read on rotation —
+same pattern as auth/credentials.py) or a minimal kubeconfig (token /
+client-cert user). TLS via the cluster CA.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+import httpx
+
+from ..apis.meta import Object, object_from_manifest
+from ..transport import TransportOptions, build_http_client, request_with_retries
+from .client import (AlreadyExistsError, ClientError, ConflictError,
+                     NotFoundError)
+from .store import ADDED, DELETED, MODIFIED, WatchEvent
+
+log = logging.getLogger("rest")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+TOKEN_REREAD_SECONDS = 60.0
+
+# Irregular kind → resource plurals would go here; everything this
+# controller touches pluralizes regularly.
+_PLURALS: dict[str, str] = {}
+
+
+def resource_path(cls: type, namespace: str = "", name: str = "") -> str:
+    """Build the API path for a registered kind."""
+    gv = cls.API_VERSION
+    base = f"/api/{gv}" if "/" not in gv else f"/apis/{gv}"
+    plural = _PLURALS.get(cls.KIND, cls.KIND.lower() + "s")
+    if cls.NAMESPACED and namespace:
+        base = f"{base}/namespaces/{namespace}"
+    path = f"{base}/{plural}"
+    return f"{path}/{name}" if name else path
+
+
+@dataclass
+class KubeConnection:
+    """Where and how to reach the apiserver."""
+
+    server: str
+    token: str = ""
+    token_file: str = ""
+    ca_file: str = ""
+    client_cert: str = ""      # PEM path (kubeconfig client-certificate)
+    client_key: str = ""
+    namespace: str = "default"
+
+    _cached_token: str = field(default="", repr=False)
+    _token_at: float = field(default=0.0, repr=False)
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConnection":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        if not host:
+            raise ClientError("not in-cluster: KUBERNETES_SERVICE_HOST unset")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        ns = "default"
+        ns_file = f"{SA_DIR}/namespace"
+        if os.path.exists(ns_file):
+            ns = open(ns_file).read().strip()
+        return cls(server=f"https://{host}:{port}",
+                   token_file=f"{SA_DIR}/token",
+                   ca_file=f"{SA_DIR}/ca.crt", namespace=ns)
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None) -> "KubeConnection":
+        import yaml
+        path = path or os.environ.get("KUBECONFIG",
+                                      os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            kc = yaml.safe_load(f)
+        ctx_name = kc.get("current-context", "")
+        ctx = next(c["context"] for c in kc["contexts"] if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in kc["clusters"]
+                       if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in kc["users"] if u["name"] == ctx["user"])
+
+        def materialize(data_key: str, file_key: str, src: dict) -> str:
+            if file_key in src:
+                return src[file_key]
+            if data_key in src:
+                f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+                f.write(base64.b64decode(src[data_key]))
+                f.close()
+                return f.name
+            return ""
+
+        return cls(
+            server=cluster["server"],
+            ca_file=materialize("certificate-authority-data",
+                                "certificate-authority", cluster),
+            token=user.get("token", ""),
+            client_cert=materialize("client-certificate-data",
+                                    "client-certificate", user),
+            client_key=materialize("client-key-data", "client-key", user),
+            namespace=ctx.get("namespace", "default"))
+
+    def bearer(self, loop_time: float) -> str:
+        if self.token:
+            return self.token
+        if not self.token_file:
+            return ""
+        if (not self._cached_token
+                or loop_time - self._token_at > TOKEN_REREAD_SECONDS):
+            self._cached_token = open(self.token_file).read().strip()
+            self._token_at = loop_time
+        return self._cached_token
+
+    def build_http(self, opts: Optional[TransportOptions] = None) -> httpx.AsyncClient:
+        verify: object = True
+        if self.ca_file:
+            ctx = ssl.create_default_context(cafile=self.ca_file)
+            if self.client_cert:
+                ctx.load_cert_chain(self.client_cert, self.client_key or None)
+            verify = ctx
+        return build_http_client(opts, verify=verify, base_url=self.server)
+
+
+def _error_for(resp: httpx.Response, verb: str) -> ClientError:
+    body = resp.text[:512]
+    if resp.status_code == 404:
+        return NotFoundError(body)
+    if resp.status_code == 409:
+        # POST conflicts mean the object exists; PUT conflicts mean a stale
+        # resourceVersion — the two distinct retry paths upstream.
+        return AlreadyExistsError(body) if verb == "create" else ConflictError(body)
+    return ClientError(f"{verb}: HTTP {resp.status_code}: {body}")
+
+
+class RestClient:
+    """Client protocol implementation over the Kubernetes REST API."""
+
+    def __init__(self, conn: KubeConnection,
+                 transport: Optional[TransportOptions] = None,
+                 http: Optional[httpx.AsyncClient] = None):
+        self.conn = conn
+        self.topts = transport or TransportOptions()
+        self.http = http or conn.build_http(self.topts)
+        self._indexes: dict[tuple[type, str], object] = {}
+
+    # index emulation: same registration surface as Store.add_index; REST has
+    # no server-side field indexes for these, so list filters client-side.
+    def add_index(self, cls: type, name: str, key_fn) -> None:
+        self._indexes[(cls, name)] = key_fn
+
+    async def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        tok = self.conn.bearer(asyncio.get_event_loop().time())
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    async def _req(self, verb: str, method: str, path: str, **kw) -> httpx.Response:
+        resp = await request_with_retries(
+            self.http, method, path, opts=self.topts,
+            headers=await self._headers(), **kw)
+        if resp.status_code >= 400:
+            raise _error_for(resp, verb)
+        return resp
+
+    async def get(self, cls: type, name: str, namespace: str = "") -> Object:
+        resp = await self._req("get", "GET",
+                               resource_path(cls, namespace, name))
+        return object_from_manifest(resp.json())
+
+    async def list(self, cls: type, labels: Optional[dict[str, str]] = None,
+                   namespace: Optional[str] = None,
+                   index: Optional[tuple[str, str]] = None) -> list[Object]:
+        params = {}
+        if labels:
+            params["labelSelector"] = ",".join(f"{k}={v}" for k, v in labels.items())
+        resp = await self._req("list", "GET",
+                               resource_path(cls, namespace or ""),
+                               params=params)
+        items = []
+        for item in resp.json().get("items", []):
+            item.setdefault("kind", cls.KIND)
+            item.setdefault("apiVersion", cls.API_VERSION)
+            items.append(cls.from_dict(item))
+        if index is not None:
+            name, value = index
+            key_fn = self._indexes.get((cls, name))
+            if key_fn is None:
+                raise ClientError(f"no index {name!r} registered for {cls.KIND}")
+            items = [o for o in items if value in (key_fn(o) or [])]
+        return items
+
+    async def create(self, obj: Object) -> Object:
+        resp = await self._req("create", "POST",
+                               resource_path(type(obj), obj.metadata.namespace),
+                               json=obj.to_dict())
+        return object_from_manifest(resp.json())
+
+    async def update(self, obj: Object) -> Object:
+        resp = await self._req(
+            "update", "PUT",
+            resource_path(type(obj), obj.metadata.namespace, obj.metadata.name),
+            json=obj.to_dict())
+        return object_from_manifest(resp.json())
+
+    async def update_status(self, obj: Object) -> Object:
+        resp = await self._req(
+            "update", "PUT",
+            resource_path(type(obj), obj.metadata.namespace,
+                          obj.metadata.name) + "/status",
+            json=obj.to_dict())
+        return object_from_manifest(resp.json())
+
+    async def delete(self, cls: type, name: str, namespace: str = "") -> None:
+        await self._req("delete", "DELETE", resource_path(cls, namespace, name))
+
+    def watch(self, cls: type) -> "RestWatch":
+        return RestWatch(self, cls)
+
+    async def aclose(self) -> None:
+        await self.http.aclose()
+
+
+class RestWatch:
+    """ListAndWatch with re-list on breakage. Same surface as runtime.Watch."""
+
+    RECONNECT_BACKOFF = 1.0
+
+    def __init__(self, client: RestClient, cls: type):
+        self.client = client
+        self.cls = cls
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self._task = asyncio.ensure_future(self._run())
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._closed:
+            raise StopAsyncIteration
+        ev = await self._q.get()
+        if ev is None or self._closed:
+            raise StopAsyncIteration
+        return ev
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._task.cancel()
+        self._q.put_nowait(None)
+
+    async def _run(self) -> None:
+        rv = ""
+        while not self._closed:
+            try:
+                if not rv:
+                    # replay on EVERY (re-)list, not just the first: events
+                    # that fired during a watch outage would otherwise be
+                    # lost forever (no periodic resync downstream). Duplicate
+                    # ADDED events are harmless — reconciles are
+                    # level-triggered and the workqueue dedups by key.
+                    rv = await self._list_into_queue()
+                rv = await self._stream(rv)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                log.warning("watch %s broken: %s; re-listing",
+                            self.cls.KIND, e)
+                rv = ""  # force re-list
+                await asyncio.sleep(self.RECONNECT_BACKOFF)
+
+    async def _list_into_queue(self) -> str:
+        resp = await self.client._req("list", "GET",
+                                      resource_path(self.cls))
+        body = resp.json()
+        for item in body.get("items", []):
+            item.setdefault("kind", self.cls.KIND)
+            item.setdefault("apiVersion", self.cls.API_VERSION)
+            self._q.put_nowait(WatchEvent(ADDED, self.cls.from_dict(item)))
+        return body.get("metadata", {}).get("resourceVersion", "")
+
+    async def _stream(self, rv: str) -> str:
+        params = {"watch": "true", "allowWatchBookmarks": "true"}
+        if rv:
+            params["resourceVersion"] = rv
+        headers = await self.client._headers()
+        async with self.client.http.stream(
+                "GET", resource_path(self.cls), params=params,
+                headers=headers, timeout=None) as resp:
+            if resp.status_code >= 400:
+                raise ClientError(f"watch: HTTP {resp.status_code}")
+            async for line in resp.aiter_lines():
+                if self._closed:
+                    return rv
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                etype, raw = ev["type"], ev["object"]
+                new_rv = raw.get("metadata", {}).get("resourceVersion", "")
+                if etype == "BOOKMARK":
+                    rv = new_rv or rv
+                    continue
+                if etype == "ERROR":  # e.g. 410 Gone — re-list
+                    raise ClientError(f"watch error event: {raw}")
+                raw.setdefault("kind", self.cls.KIND)
+                raw.setdefault("apiVersion", self.cls.API_VERSION)
+                if etype in (ADDED, MODIFIED, DELETED):
+                    self._q.put_nowait(
+                        WatchEvent(etype, self.cls.from_dict(raw)))
+                rv = new_rv or rv
+        return rv

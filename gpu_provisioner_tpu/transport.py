@@ -1,0 +1,73 @@
+"""Pooled, retrying HTTP transport shared by the kube and GCP REST clients.
+
+The analog of the reference's ARM transport stack (pkg/utils/opts):
+armbalancer pool of 100 connections (init_http_client.go:29-52) and a
+20-retry / 5s-exponential-backoff policy (armopts.go:34-40). HTTP/1.1 here
+(no h2 in this image); the pool limit is what matters for burst reconciles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+
+import httpx
+
+RETRYABLE_STATUS = frozenset({408, 429, 500, 502, 503, 504})
+# For cloud APIs 429 is a *semantic* answer (stockout/quota → the
+# InsufficientCapacity lifecycle path), not throttling — never eat it in the
+# transport; the kube apiserver's 429 IS throttling and stays retryable.
+GCP_RETRYABLE_STATUS = RETRYABLE_STATUS - {429}
+
+
+@dataclass
+class TransportOptions:
+    max_retries: int = 20          # armopts.go:36
+    backoff_base: float = 5.0      # armopts.go:37 (exponential, seconds)
+    backoff_cap: float = 60.0
+    pool_connections: int = 100    # init_http_client.go:34
+    timeout: float = 60.0
+    user_agent: str = "tpu-provisioner"
+    retryable_status: frozenset[int] = RETRYABLE_STATUS
+
+
+def build_http_client(opts: TransportOptions | None = None,
+                      verify=True, **kw) -> httpx.AsyncClient:
+    opts = opts or TransportOptions()
+    return httpx.AsyncClient(
+        timeout=opts.timeout,
+        limits=httpx.Limits(max_connections=opts.pool_connections,
+                            max_keepalive_connections=opts.pool_connections),
+        headers={"User-Agent": opts.user_agent},
+        verify=verify, **kw)
+
+
+async def request_with_retries(http: httpx.AsyncClient, method: str, url: str,
+                               opts: TransportOptions | None = None,
+                               **kw) -> httpx.Response:
+    """Issue a request, retrying transient failures with capped exponential
+    backoff. Any response that is not retryable — and the LAST response when
+    the retry budget runs out — is returned as-is: the caller owns error
+    taxonomy mapping (e.g. 429 → InsufficientCapacity must survive the
+    transport). Only exhausted transport-level failures raise."""
+    opts = opts or TransportOptions()
+    last_exc: Exception | None = None
+    last_resp: httpx.Response | None = None
+    for attempt in range(opts.max_retries + 1):
+        try:
+            resp = await http.request(method, url, **kw)
+        except (httpx.TransportError, httpx.TimeoutException) as e:
+            last_exc, last_resp = e, None
+        else:
+            if resp.status_code not in opts.retryable_status:
+                return resp
+            last_resp = resp
+        if attempt == opts.max_retries:
+            break
+        delay = min(opts.backoff_cap,
+                    opts.backoff_base * (2 ** min(attempt, 6)))
+        await asyncio.sleep(delay * (0.5 + random.random() / 2))
+    if last_resp is not None:
+        return last_resp
+    raise last_exc  # type: ignore[misc]

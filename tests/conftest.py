@@ -43,3 +43,7 @@ def async_test(fn):
 @pytest.fixture
 def anyio_backend():
     return "asyncio"
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "e2e: end-to-end specs (operator subprocess)")

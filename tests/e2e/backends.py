@@ -1,0 +1,338 @@
+"""HTTP fakes for the e2e suite: a kube apiserver and a GCP endpoint.
+
+The reference's e2e tier runs against a real AKS cluster (SURVEY.md §4.3);
+this harness gets the same black-box property on a laptop: the REAL operator
+process speaks REAL HTTP to (a) an apiserver facade over runtime.Store —
+which already implements resourceVersion conflicts, finalizer-gated deletes
+and watch streams — and (b) a GCP facade over fake.FakeCloud, which
+materializes Node objects into that same store when node pools come up,
+exactly as GKE's kubelets would. Against a live GKE cluster the same specs
+run by pointing Environment at the production endpoints instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from gpu_provisioner_tpu.apis.meta import Object, kind_for
+from gpu_provisioner_tpu.providers.gcp import APIError, NodePool, QueuedResource
+from gpu_provisioner_tpu.fake.cloud import FakeCloud
+from gpu_provisioner_tpu.runtime import InMemoryClient
+from gpu_provisioner_tpu.runtime.store import (StoreAlreadyExists,
+                                               StoreConflict, StoreNotFound)
+
+# plural → Kind for every kind the controllers touch; reverse of
+# runtime.rest.resource_path's pluralization.
+def _cls_for(plural: str) -> type:
+    return kind_for({
+        "nodeclaims": "NodeClaim", "nodes": "Node", "pods": "Pod",
+        "volumeattachments": "VolumeAttachment", "events": "Event",
+        "kaitonodeclasses": "KaitoNodeClass",
+    }[plural])
+
+
+class FakeKubeAPIServer:
+    """Apiserver facade over runtime.Store (shared with the fake cloud)."""
+
+    def __init__(self, client: Optional[InMemoryClient] = None):
+        self.client = client or InMemoryClient()
+        self.store = self.client.store
+        self.app = web.Application()
+        for base in ("/api/v1", "/apis/{group}/{version}"):
+            self.app.router.add_route("*", base + "/{plural}", self._collection)
+            self.app.router.add_route("*", base + "/{plural}/{name}", self._item)
+            self.app.router.add_route(
+                "PUT", base + "/{plural}/{name}/status", self._status)
+            self.app.router.add_route(
+                "*", base + "/namespaces/{ns}/{plural}", self._collection)
+            self.app.router.add_route(
+                "*", base + "/namespaces/{ns}/{plural}/{name}", self._item)
+            self.app.router.add_route(
+                "PUT", base + "/namespaces/{ns}/{plural}/{name}/status",
+                self._status)
+        self.runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(self.app, shutdown_timeout=1.0)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    # --- helpers -----------------------------------------------------------
+
+    def _parse(self, req: web.Request) -> tuple[type, str, str]:
+        try:
+            cls = _cls_for(req.match_info["plural"])
+        except KeyError:
+            raise web.HTTPNotFound(text=f"unknown resource "
+                                        f"{req.match_info['plural']!r}")
+        return (cls, req.match_info.get("ns", ""),
+                req.match_info.get("name", ""))
+
+    @staticmethod
+    def _json(obj: Object, status: int = 200) -> web.Response:
+        return web.json_response(obj.to_dict(), status=status)
+
+    # --- routes ------------------------------------------------------------
+
+    async def _collection(self, req: web.Request) -> web.StreamResponse:
+        cls, ns, _ = self._parse(req)
+        if req.method == "POST":
+            obj = cls.from_dict(await req.json())
+            try:
+                created = self.store.create(obj)
+            except StoreAlreadyExists as e:
+                return web.Response(status=409, text=str(e))
+            return self._json(created, 201)
+        if req.method != "GET":
+            return web.Response(status=405)
+        if req.query.get("watch") == "true":
+            return await self._watch(req, cls)
+        labels = None
+        sel = req.query.get("labelSelector", "")
+        if sel:
+            labels = dict(p.split("=", 1) for p in sel.split(","))
+        items = self.store.list(cls, labels, ns or None)
+        return web.json_response({
+            "kind": f"{cls.KIND}List",
+            "items": [o.to_dict() for o in items],
+            "metadata": {"resourceVersion": str(self.store.current_rv())
+                         if hasattr(self.store, "current_rv") else "0"}})
+
+    async def _watch(self, req: web.Request, cls: type) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(req)
+        q = self.store.watch(cls, initial_list=False)
+        try:
+            while True:
+                try:
+                    ev = await asyncio.wait_for(q.get(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    # q.get() would otherwise block past a silent peer
+                    # disconnect and hang server shutdown for its full grace
+                    if req.transport is None or req.transport.is_closing():
+                        break
+                    continue
+                line = json.dumps({"type": ev.type,
+                                   "object": ev.object.to_dict()}) + "\n"
+                await resp.write(line.encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self.store.unwatch(cls, q)
+        return resp
+
+    async def _item(self, req: web.Request) -> web.Response:
+        cls, ns, name = self._parse(req)
+        try:
+            if req.method == "GET":
+                return self._json(self.store.get(cls, name, ns))
+            if req.method == "PUT":
+                return self._json(self.store.update(cls.from_dict(await req.json())))
+            if req.method == "DELETE":
+                self.store.delete(cls, name, ns)
+                return web.json_response({"status": "Success"})
+        except StoreNotFound as e:
+            return web.Response(status=404, text=str(e))
+        except StoreConflict as e:
+            return web.Response(status=409, text=str(e))
+        return web.Response(status=405)
+
+    async def _status(self, req: web.Request) -> web.Response:
+        cls, ns, name = self._parse(req)
+        try:
+            return self._json(self.store.update_status(cls.from_dict(await req.json())))
+        except StoreNotFound as e:
+            return web.Response(status=404, text=str(e))
+        except StoreConflict as e:
+            return web.Response(status=409, text=str(e))
+
+
+class FakeGCPServer:
+    """GKE + Cloud TPU facade over fake.FakeCloud (container/v1 + tpu/v2
+    wire shapes, matching providers/rest.py's translation)."""
+
+    def __init__(self, cloud: FakeCloud):
+        self.cloud = cloud
+        self.ops: dict[str, object] = {}
+        self._op_ids = itertools.count(1)
+        self.app = web.Application()
+        r = self.app.router
+        npp = "/v1/projects/{p}/locations/{l}/clusters/{c}/nodePools"
+        r.add_route("POST", npp, self._np_create)
+        r.add_route("GET", npp, self._np_list)
+        r.add_route("GET", npp + "/{name}", self._np_get)
+        r.add_route("DELETE", npp + "/{name}", self._np_delete)
+        r.add_route("GET", "/v1/projects/{p}/locations/{l}/operations/{op}",
+                    self._op_get)
+        qrp = "/v2/projects/{p}/locations/{l}/queuedResources"
+        r.add_route("POST", qrp, self._qr_create)
+        r.add_route("GET", qrp, self._qr_list)
+        r.add_route("GET", qrp + "/{name}", self._qr_get)
+        r.add_route("DELETE", qrp + "/{name}", self._qr_delete)
+        self.runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(self.app, shutdown_timeout=1.0)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    # --- node pools --------------------------------------------------------
+
+    @staticmethod
+    def _api_error(e: APIError) -> web.Response:
+        return web.Response(status=e.code, text=str(e))
+
+    def _track(self, op) -> dict:
+        op_id = f"operation-{next(self._op_ids)}"
+        self.ops[op_id] = op
+        return {"name": op_id, "status": "RUNNING"}
+
+    async def _np_create(self, req: web.Request) -> web.Response:
+        wire = (await req.json())["nodePool"]
+        cfg = wire.get("config", {})
+        ra = cfg.get("reservationAffinity", {})
+        pool = NodePool.from_dict({
+            "name": wire["name"],
+            "initialNodeCount": wire.get("initialNodeCount", 1),
+            "config": {
+                "machineType": cfg.get("machineType", ""),
+                "diskSizeGb": cfg.get("diskSizeGb", 0),
+                "labels": cfg.get("labels", {}),
+                "taints": cfg.get("taints", []),
+                "spot": cfg.get("spot", False),
+                "imageType": cfg.get("imageType", ""),
+                "reservation": (ra.get("values") or [""])[0]},
+            "placementPolicy": (
+                {"type": wire["placementPolicy"].get("type", "COMPACT"),
+                 "tpuTopology": wire["placementPolicy"].get("tpuTopology", "")}
+                if "placementPolicy" in wire else None)})
+        try:
+            op = await self.cloud.nodepools.begin_create(pool)
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response(self._track(op))
+
+    def _np_wire(self, p: NodePool) -> dict:
+        d = {"name": p.name, "status": p.status,
+             "statusMessage": p.status_message,
+             "initialNodeCount": p.initial_node_count,
+             "config": {"machineType": p.config.machine_type,
+                        "diskSizeGb": p.config.disk_size_gb,
+                        "labels": p.config.labels,
+                        "taints": p.config.taints,
+                        "spot": p.config.spot,
+                        "imageType": p.config.image_type}}
+        if p.config.reservation:
+            d["config"]["reservationAffinity"] = {
+                "consumeReservationType": "SPECIFIC_RESERVATION",
+                "key": "compute.googleapis.com/reservation-name",
+                "values": [p.config.reservation]}
+        if p.placement_policy:
+            d["placementPolicy"] = {"type": p.placement_policy.type,
+                                    "tpuTopology": p.placement_policy.tpu_topology}
+        return d
+
+    async def _np_get(self, req: web.Request) -> web.Response:
+        try:
+            pool = await self.cloud.nodepools.get(req.match_info["name"])
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response(self._np_wire(pool))
+
+    async def _np_delete(self, req: web.Request) -> web.Response:
+        try:
+            op = await self.cloud.nodepools.begin_delete(req.match_info["name"])
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response(self._track(op))
+
+    async def _np_list(self, req: web.Request) -> web.Response:
+        pools = await self.cloud.nodepools.list()
+        return web.json_response({"nodePools": [self._np_wire(p) for p in pools]})
+
+    async def _op_get(self, req: web.Request) -> web.Response:
+        op = self.ops.get(req.match_info["op"])
+        if op is None:
+            return web.Response(status=404, text="operation not found")
+        if not await op.done():
+            return web.json_response({"name": req.match_info["op"],
+                                      "status": "RUNNING"})
+        body = {"name": req.match_info["op"], "status": "DONE"}
+        try:
+            await op.result()
+        except APIError as e:
+            # real container/v1 Operation.error is a google.rpc.Status
+            body["error"] = {"code": {429: 8, 404: 5, 409: 6}.get(e.code, 13),
+                             "message": str(e)}
+        return web.json_response(body)
+
+    # --- queued resources --------------------------------------------------
+
+    def _qr_wire(self, qr: QueuedResource) -> dict:
+        node = {"acceleratorType": qr.accelerator_type,
+                "runtimeVersion": qr.runtime_version}
+        if qr.spot:
+            node["schedulingConfig"] = {"spot": True}
+        wire = {"name": f"queuedResources/{qr.name}",
+                "tpu": {"nodeSpec": [{"nodeId": qr.node_pool, "node": node}]},
+                "state": {"state": qr.state}}
+        if qr.reservation:
+            wire["reservationName"] = qr.reservation
+        return wire
+
+    async def _qr_create(self, req: web.Request) -> web.Response:
+        wire = await req.json()
+        spec = (wire.get("tpu", {}).get("nodeSpec") or [{}])[0]
+        node = spec.get("node", {})
+        qr = QueuedResource(
+            name=req.query["queuedResourceId"],
+            accelerator_type=node.get("acceleratorType", ""),
+            runtime_version=node.get("runtimeVersion", ""),
+            node_pool=spec.get("nodeId", ""),
+            reservation=wire.get("reservationName", ""),
+            spot=bool(node.get("schedulingConfig", {}).get("spot", False)))
+        try:
+            await self.cloud.queuedresources.create(qr)
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response({"name": "operations/qr-create"})
+
+    async def _qr_get(self, req: web.Request) -> web.Response:
+        try:
+            qr = await self.cloud.queuedresources.get(req.match_info["name"])
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response(self._qr_wire(qr))
+
+    async def _qr_delete(self, req: web.Request) -> web.Response:
+        try:
+            await self.cloud.queuedresources.delete(req.match_info["name"])
+        except APIError as e:
+            return self._api_error(e)
+        return web.json_response({})
+
+    async def _qr_list(self, req: web.Request) -> web.Response:
+        qrs = await self.cloud.queuedresources.list()
+        return web.json_response({"queuedResources": [self._qr_wire(q) for q in qrs]})

@@ -1,0 +1,233 @@
+"""e2e Environment: real operator process against the HTTP fakes.
+
+The analog of the reference harness's Environment + Monitor + expectations
+(test/e2e/pkg/environment/common/environment.go:56-88, monitor.go:32-100,
+expectation.go:45-415): spins up the apiserver/GCP facades, launches the
+operator as a SUBPROCESS (black box — real flags, env, HTTP, signals), and
+exposes an expectation surface with Eventually semantics plus controller log
+dump on failure (expectation.go:375's printControllerLogs analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import sys
+import time
+from typing import Optional
+
+import httpx
+import yaml
+
+from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+from gpu_provisioner_tpu.fake.cloud import FakeCloud
+from gpu_provisioner_tpu.runtime import InMemoryClient
+from gpu_provisioner_tpu.runtime.client import NotFoundError
+from gpu_provisioner_tpu.runtime.rest import KubeConnection, RestClient
+from gpu_provisioner_tpu.transport import TransportOptions
+
+from .backends import FakeGCPServer, FakeKubeAPIServer
+
+DEFAULT_TIMEOUT = 30.0  # fake cloud is fast; reference uses 10 min on real AKS
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Environment:
+    def __init__(self, tmp_path, *, gc_interval: float = 1.0,
+                 leak_grace: float = 1.0, extra_env: Optional[dict] = None,
+                 cloud_kwargs: Optional[dict] = None):
+        self.tmp_path = tmp_path
+        self.gc_interval = gc_interval
+        self.leak_grace = leak_grace
+        self.extra_env = extra_env or {}
+        self.cloud_kwargs = cloud_kwargs or {}
+        self.backing = InMemoryClient()
+        self.cloud = FakeCloud(self.backing, create_latency=0.1,
+                               delete_latency=0.05, node_ready_delay=0.05,
+                               **self.cloud_kwargs)
+        self.kube_server = FakeKubeAPIServer(self.backing)
+        self.gcp_server = FakeGCPServer(self.cloud)
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.client: Optional[RestClient] = None
+        self._log_task = None
+        self.logs: list[str] = []
+        self.health_port = _free_port()
+        self.metrics_port = _free_port()
+
+    async def __aenter__(self) -> "Environment":
+        kube_url = await self.kube_server.start()
+        gcp_url = await self.gcp_server.start()
+
+        kubeconfig = self.tmp_path / "kubeconfig"
+        kubeconfig.write_text(yaml.safe_dump({
+            "current-context": "e2e",
+            "contexts": [{"name": "e2e",
+                          "context": {"cluster": "e2e", "user": "e2e"}}],
+            "clusters": [{"name": "e2e", "cluster": {"server": kube_url}}],
+            "users": [{"name": "e2e", "user": {"token": "e2e-token"}}],
+        }))
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = {**os.environ,
+               "PYTHONPATH": repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               "KUBECONFIG": str(kubeconfig),
+               "KUBERNETES_SERVICE_HOST": "",   # force kubeconfig path
+               "PROJECT_ID": "test-project", "LOCATION": "us-central2-b",
+               "CLUSTER_NAME": "kaito",
+               "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "e2e-token",
+               "GKE_API_ENDPOINT": f"{gcp_url}/v1",
+               "TPU_API_ENDPOINT": f"{gcp_url}/v2",
+               "METRICS_PORT": str(self.metrics_port),
+               "HEALTH_PROBE_PORT": str(self.health_port),
+               "GC_INTERVAL_SECONDS": str(self.gc_interval),
+               "GC_LEAK_GRACE_SECONDS": str(self.leak_grace),
+               "TERMINATION_REQUEUE_SECONDS": "0.2",
+               "INSTANCE_REQUEUE_SECONDS": "0.2",
+               "LOG_LEVEL": "debug",
+               **self.extra_env}
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "gpu_provisioner_tpu.operator", env=env,
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+        self._log_task = asyncio.create_task(self._pump_logs())
+
+        self.client = RestClient(
+            KubeConnection(server=kube_url, token="e2e-token"),
+            transport=TransportOptions(max_retries=3, backoff_base=0.05,
+                                       backoff_cap=0.2))
+        await self._await_ready()
+        return self
+
+    async def _pump_logs(self) -> None:
+        assert self.proc and self.proc.stdout
+        async for line in self.proc.stdout:
+            self.logs.append(line.decode(errors="replace").rstrip())
+
+    async def _await_ready(self) -> None:
+        async with httpx.AsyncClient() as http:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if self.proc.returncode is not None:
+                    self.dump_logs()
+                    raise RuntimeError(
+                        f"operator exited rc={self.proc.returncode}")
+                try:
+                    r = await http.get(
+                        f"http://127.0.0.1:{self.health_port}/readyz")
+                    if r.status_code == 200:
+                        return
+                except httpx.TransportError:
+                    pass
+                await asyncio.sleep(0.1)
+        self.dump_logs()
+        raise TimeoutError("operator /readyz never became 200")
+
+    async def __aexit__(self, *exc) -> None:
+        if self.proc and self.proc.returncode is None:
+            self.proc.terminate()
+            try:
+                await asyncio.wait_for(self.proc.wait(), 10)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+                await self.proc.wait()
+        if self._log_task:
+            self._log_task.cancel()
+        if self.client:
+            await self.client.aclose()
+        await self.gcp_server.stop()
+        await self.kube_server.stop()
+        if exc and exc[0] is not None:
+            self.dump_logs()
+
+    def dump_logs(self) -> None:
+        print("\n--- operator logs " + "-" * 50)
+        for line in self.logs[-200:]:
+            print(line)
+        print("--- end operator logs " + "-" * 46)
+
+    # --- expectations ------------------------------------------------------
+
+    async def eventually(self, predicate, timeout: float = DEFAULT_TIMEOUT,
+                         what: str = "condition"):
+        """Poll an async predicate until truthy (Gomega Eventually analog).
+        Returns the predicate's value."""
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            last = await predicate()
+            if last:
+                return last
+            await asyncio.sleep(0.1)
+        self.dump_logs()
+        raise TimeoutError(f"{what} not met within {timeout}s (last={last!r})")
+
+    async def expect_nodeclaim_ready(self, name: str,
+                                     timeout: float = DEFAULT_TIMEOUT) -> NodeClaim:
+        async def check():
+            try:
+                nc = await self.client.get(NodeClaim, name)
+            except NotFoundError:
+                return None
+            return nc if nc.status_conditions.is_true(CONDITION_READY) else None
+
+        return await self.eventually(check, timeout,
+                                     f"NodeClaim {name} Ready")
+
+    async def expect_node_count(self, n: int,
+                                timeout: float = DEFAULT_TIMEOUT) -> list[Node]:
+        async def check():
+            nodes = await self.client.list(Node)
+            # `or True` so expecting zero nodes doesn't return a falsy []
+            return (nodes or True) if len(nodes) == n else None
+
+        result = await self.eventually(check, timeout, f"{n} nodes")
+        return result if result is not True else []
+
+    async def expect_gone(self, cls: type, name: str,
+                          timeout: float = DEFAULT_TIMEOUT) -> None:
+        async def check():
+            try:
+                await self.client.get(cls, name)
+                return None
+            except NotFoundError:
+                return True
+
+        await self.eventually(check, timeout, f"{cls.KIND} {name} gone")
+
+
+class Monitor:
+    """Counts created/deleted nodes vs a reset point (monitor.go:32-100)."""
+
+    def __init__(self, env: Environment):
+        self.env = env
+        self._baseline: set[str] = set()
+        self._seen: set[str] = set()
+
+    async def reset(self) -> None:
+        self._baseline = {n.metadata.name
+                          for n in await self.env.client.list(Node)}
+        self._seen = set(self._baseline)
+
+    async def _observe(self) -> set[str]:
+        names = {n.metadata.name for n in await self.env.client.list(Node)}
+        self._seen |= names
+        return names
+
+    async def created_count(self) -> int:
+        await self._observe()
+        return len(self._seen - self._baseline)
+
+    async def deleted_count(self) -> int:
+        """Nodes observed since reset() that are now gone — counting requires
+        having polled (e.g. via created_count) while they existed."""
+        current = await self._observe()
+        return len(self._seen - current)

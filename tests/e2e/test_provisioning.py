@@ -1,0 +1,46 @@
+"""e2e smoke: the real operator process provisions and tears down a slice.
+
+First two of the reference suite's specs (suite_test.go:49 provision via
+workspace label, :183 teardown via NodeClaim delete) run against the HTTP
+fakes; the full 8-spec suite lives in test_suite.py. Marked e2e — slower
+than unit tests (subprocess + HTTP + real timers).
+"""
+
+import pytest
+
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.fake import make_nodeclaim
+
+from ..conftest import async_test
+from .env import Environment, Monitor
+
+pytestmark = pytest.mark.e2e
+
+
+@async_test
+async def test_provision_and_teardown_multihost(tmp_path):
+    async with Environment(tmp_path) as env:
+        mon = Monitor(env)
+        await mon.reset()
+
+        # multi-host: v5p-32 = 4 hosts (BASELINE.json north star shape)
+        await env.client.create(make_nodeclaim("ws0", "tpu-v5p-32"))
+        nc = await env.expect_nodeclaim_ready("ws0")
+        assert nc.status.provider_id
+        assert nc.metadata.labels[wk.TPU_TOPOLOGY_LABEL] == "2x2x4"
+
+        nodes = await env.expect_node_count(4)
+        indices = sorted(n.metadata.labels[wk.TPU_WORKER_INDEX_LABEL]
+                         for n in nodes)
+        assert indices == ["0", "1", "2", "3"]
+        assert await mon.created_count() == 4
+
+        # teardown via NodeClaim delete (suite_test.go:183): finalizer drains
+        # nodes, deletes the node pool, then the claim disappears
+        await env.client.delete(NodeClaim, "ws0")
+        await env.expect_gone(NodeClaim, "ws0")
+        await env.expect_node_count(0)
+        assert await mon.deleted_count() == 4
+        assert not await env.cloud.nodepools.list()

@@ -1,0 +1,359 @@
+"""REST layer tests: kube RestClient, GKE/CloudTPU clients, transport retry.
+
+Mirrors the reference's mock-the-wire approach (pkg/fake mocks the 4-method
+ARM seam; here httpx.MockTransport mocks the HTTP boundary itself, one level
+lower, so path building and error-taxonomy mapping are covered too).
+"""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from gpu_provisioner_tpu.apis.core import Node, Pod
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.auth.credentials import StaticTokenCredential
+from gpu_provisioner_tpu.providers.gcp import (APIError, NodePool,
+                                               NodePoolConfig, PlacementPolicy,
+                                               QueuedResource)
+from gpu_provisioner_tpu.providers.rest import (CloudTPUQueuedResourcesClient,
+                                                GKENodePoolsClient)
+from gpu_provisioner_tpu.runtime.client import (AlreadyExistsError,
+                                                ConflictError, NotFoundError)
+from gpu_provisioner_tpu.runtime.rest import (KubeConnection, RestClient,
+                                              resource_path)
+from gpu_provisioner_tpu.runtime.store import ADDED, MODIFIED
+from gpu_provisioner_tpu.transport import TransportOptions, request_with_retries
+
+from .conftest import async_test
+
+FAST = TransportOptions(max_retries=2, backoff_base=0.01, backoff_cap=0.02)
+
+
+def make_kube_client(handler) -> RestClient:
+    conn = KubeConnection(server="https://kube.test", token="tok")
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler),
+                             base_url="https://kube.test")
+    return RestClient(conn, transport=FAST, http=http)
+
+
+# --- path building ---------------------------------------------------------
+
+def test_resource_paths():
+    assert resource_path(NodeClaim) == "/apis/karpenter.sh/v1/nodeclaims"
+    assert resource_path(NodeClaim, name="x") == "/apis/karpenter.sh/v1/nodeclaims/x"
+    assert resource_path(Node, name="n1") == "/api/v1/nodes/n1"
+    assert resource_path(Pod, "ns1", "p") == "/api/v1/namespaces/ns1/pods/p"
+    assert resource_path(Pod) == "/api/v1/pods"  # all-namespaces list
+
+
+# --- CRUD + error taxonomy -------------------------------------------------
+
+@async_test
+async def test_kube_crud_roundtrip():
+    store: dict[str, dict] = {}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        assert req.headers["Authorization"] == "Bearer tok"
+        path = req.url.path
+        if req.method == "POST":
+            obj = json.loads(req.content)
+            name = obj["metadata"]["name"]
+            if name in store:
+                return httpx.Response(409, text="exists")
+            store[name] = obj
+            return httpx.Response(201, json=obj)
+        if req.method == "PUT":
+            name = path.rsplit("/", 2)[-2] if path.endswith("/status") \
+                else path.rsplit("/", 1)[-1]
+            store[name] = json.loads(req.content)
+            return httpx.Response(200, json=store[name])
+        if req.method == "DELETE":
+            name = path.rsplit("/", 1)[-1]
+            return httpx.Response(200) if store.pop(name, None) \
+                else httpx.Response(404, text="nope")
+        name = path.rsplit("/", 1)[-1]
+        if name == "nodeclaims":  # list
+            sel = req.url.params.get("labelSelector", "")
+            items = list(store.values())
+            if sel:
+                k, v = sel.split("=", 1)
+                items = [o for o in items
+                         if o["metadata"].get("labels", {}).get(k) == v]
+            return httpx.Response(200, json={"items": items,
+                                             "metadata": {"resourceVersion": "9"}})
+        if name in store:
+            return httpx.Response(200, json=store[name])
+        return httpx.Response(404, text="nope")
+
+    c = make_kube_client(handler)
+    nc = NodeClaim()
+    nc.metadata.name = "w0"
+    nc.metadata.labels = {"kaito.sh/workspace": "ws"}
+    created = await c.create(nc)
+    assert created.metadata.name == "w0"
+    with pytest.raises(AlreadyExistsError):
+        await c.create(nc)
+
+    got = await c.get(NodeClaim, "w0")
+    assert got.metadata.labels["kaito.sh/workspace"] == "ws"
+
+    got.metadata.labels["x"] = "y"
+    await c.update(got)
+    await c.update_status(got)
+
+    assert len(await c.list(NodeClaim, labels={"kaito.sh/workspace": "ws"})) == 1
+    assert await c.list(NodeClaim, labels={"kaito.sh/workspace": "zz"}) == []
+
+    await c.delete(NodeClaim, "w0")
+    with pytest.raises(NotFoundError):
+        await c.get(NodeClaim, "w0")
+    with pytest.raises(NotFoundError):
+        await c.delete(NodeClaim, "w0")
+
+
+@async_test
+async def test_kube_conflict_on_put():
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(409, text="stale rv")
+
+    c = make_kube_client(handler)
+    nc = NodeClaim()
+    nc.metadata.name = "w0"
+    with pytest.raises(ConflictError):
+        await c.update(nc)
+
+
+@async_test
+async def test_kube_index_filters_client_side():
+    node = {"kind": "Node", "apiVersion": "v1",
+            "metadata": {"name": "n1"}, "spec": {"providerID": "gce://p/z/i"}}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(200, json={"items": [node]})
+
+    c = make_kube_client(handler)
+    c.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
+    hit = await c.list(Node, index=("spec.providerID", "gce://p/z/i"))
+    miss = await c.list(Node, index=("spec.providerID", "gce://other"))
+    assert [n.metadata.name for n in hit] == ["n1"] and miss == []
+
+
+# --- watch -----------------------------------------------------------------
+
+@async_test
+async def test_kube_watch_replays_then_streams():
+    existing = {"kind": "NodeClaim", "apiVersion": "karpenter.sh/v1",
+                "metadata": {"name": "old", "resourceVersion": "1"}}
+    update = {"type": "MODIFIED",
+              "object": {"kind": "NodeClaim", "apiVersion": "karpenter.sh/v1",
+                         "metadata": {"name": "old", "resourceVersion": "2"}}}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.params.get("watch") == "true":
+            assert req.url.params.get("resourceVersion") == "5"
+            return httpx.Response(200, content=json.dumps(update) + "\n")
+        return httpx.Response(200, json={
+            "items": [existing], "metadata": {"resourceVersion": "5"}})
+
+    c = make_kube_client(handler)
+    w = c.watch(NodeClaim)
+    ev1 = await asyncio.wait_for(w.__anext__(), 5)
+    assert ev1.type == ADDED and ev1.object.metadata.name == "old"
+    ev2 = await asyncio.wait_for(w.__anext__(), 5)
+    assert ev2.type == MODIFIED
+    assert ev2.object.metadata.resource_version == "2"
+    w.close()
+    with pytest.raises(StopAsyncIteration):
+        await w.__anext__()
+
+
+# --- kubeconfig parsing ----------------------------------------------------
+
+def test_kubeconnection_from_kubeconfig(tmp_path):
+    kc = {
+        "current-context": "c1",
+        "contexts": [{"name": "c1", "context": {
+            "cluster": "cl", "user": "u", "namespace": "ns9"}}],
+        "clusters": [{"name": "cl", "cluster": {
+            "server": "https://1.2.3.4",
+            "certificate-authority-data":
+                __import__("base64").b64encode(b"CA PEM").decode()}}],
+        "users": [{"name": "u", "user": {"token": "sekrit"}}],
+    }
+    p = tmp_path / "kubeconfig"
+    import yaml
+    p.write_text(yaml.safe_dump(kc))
+    conn = KubeConnection.from_kubeconfig(str(p))
+    assert conn.server == "https://1.2.3.4"
+    assert conn.token == "sekrit" and conn.namespace == "ns9"
+    assert open(conn.ca_file, "rb").read() == b"CA PEM"
+
+
+# --- transport retry -------------------------------------------------------
+
+@async_test
+async def test_transport_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        return httpx.Response(503 if calls["n"] < 3 else 200, json={})
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    resp = await request_with_retries(http, "GET", "https://x.test/y", opts=FAST)
+    assert resp.status_code == 200 and calls["n"] == 3
+
+
+@async_test
+async def test_transport_does_not_retry_4xx():
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        return httpx.Response(404)
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    resp = await request_with_retries(http, "GET", "https://x.test/y", opts=FAST)
+    assert resp.status_code == 404 and calls["n"] == 1
+
+
+# --- GKE node pools client -------------------------------------------------
+
+def gke_client(handler) -> GKENodePoolsClient:
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    return GKENodePoolsClient(StaticTokenCredential("gcp-tok"), "proj",
+                              "us-central2-b", "cl", transport=FAST, http=http)
+
+
+def sample_pool() -> NodePool:
+    return NodePool(
+        name="ws0pool",
+        config=NodePoolConfig(machine_type="ct5p-hightpu-4t", disk_size_gb=100,
+                              labels={"a": "b"}, spot=True, reservation="res1",
+                              taints=[{"key": "google.com/tpu",
+                                       "value": "present",
+                                       "effect": "NO_SCHEDULE"}]),
+        initial_node_count=4,
+        placement_policy=PlacementPolicy(type="COMPACT", tpu_topology="2x2x4"))
+
+
+@async_test
+async def test_gke_create_polls_operation_and_fetches_pool():
+    ops = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        assert req.headers["Authorization"] == "Bearer gcp-tok"
+        path = req.url.path
+        if req.method == "POST":
+            body = json.loads(req.content)["nodePool"]
+            # seam→wire translation checks
+            assert body["config"]["machineType"] == "ct5p-hightpu-4t"
+            assert body["config"]["reservationAffinity"]["values"] == ["res1"]
+            assert body["placementPolicy"]["tpuTopology"] == "2x2x4"
+            assert body["initialNodeCount"] == 4
+            return httpx.Response(200, json={"name": "op-1", "status": "RUNNING"})
+        if "/operations/" in path:
+            ops["n"] += 1
+            done = ops["n"] >= 2
+            return httpx.Response(200, json={
+                "name": "op-1", "status": "DONE" if done else "RUNNING"})
+        if path.endswith("/nodePools/ws0pool"):
+            wire = json.loads(json.dumps({
+                "name": "ws0pool", "status": "RUNNING",
+                "initialNodeCount": 4,
+                "config": {"machineType": "ct5p-hightpu-4t",
+                           "reservationAffinity": {"values": ["res1"]},
+                           "spot": True},
+                "placementPolicy": {"type": "COMPACT", "tpuTopology": "2x2x4"}}))
+            return httpx.Response(200, json=wire)
+        raise AssertionError(f"unexpected {req.method} {path}")
+
+    c = gke_client(handler)
+    op = await c.begin_create(sample_pool())
+    assert not await op.done()
+    assert await op.done()
+    pool = await op.result()
+    assert pool.status == "RUNNING"
+    assert pool.config.reservation == "res1"
+    assert pool.placement_policy.tpu_topology == "2x2x4"
+
+
+@pytest.mark.parametrize("err", [
+    {"code": 8, "message": "no v5p capacity"},          # real google.rpc.Status
+    {"status": "RESOURCE_EXHAUSTED", "message": "no v5p capacity"},
+])
+@async_test
+async def test_gke_stockout_surfaces_as_exhausted(err):
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.method == "POST":
+            return httpx.Response(200, json={
+                "name": "op-1", "status": "DONE", "error": err})
+        raise AssertionError("no polling needed")
+
+    c = gke_client(handler)
+    op = await c.begin_create(sample_pool())
+    assert await op.done()
+    with pytest.raises(APIError) as ei:
+        await op.result()
+    assert ei.value.exhausted and "v5p" in str(ei.value)
+
+
+@async_test
+async def test_gke_http_429_is_not_retried_and_maps_to_exhausted():
+    """A synchronous 429 from the create POST is a stockout answer, not
+    throttling — must surface immediately as APIError.exhausted."""
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        return httpx.Response(429, text="out of v5e capacity")
+
+    c = gke_client(handler)
+    with pytest.raises(APIError) as ei:
+        await c.begin_create(sample_pool())
+    assert ei.value.exhausted and calls["n"] == 1
+
+
+@async_test
+async def test_gke_get_404_maps_to_apierror():
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(404, text="not found")
+
+    with pytest.raises(APIError) as ei:
+        await gke_client(handler).get("ghost")
+    assert ei.value.not_found
+
+
+# --- Cloud TPU queued resources client ------------------------------------
+
+@async_test
+async def test_queued_resource_create_wire_shape_and_state():
+    created = {}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        path = req.url.path
+        if req.method == "POST":
+            body = json.loads(req.content)
+            created.update(body)
+            assert req.url.params["queuedResourceId"] == "qr1"
+            spec = body["tpu"]["nodeSpec"][0]
+            assert spec["node"]["acceleratorType"] == "v5p-32"
+            assert body["reservationName"] == "res9"
+            return httpx.Response(200, json={"name": "operations/qr-op"})
+        if path.endswith("/queuedResources/qr1"):
+            return httpx.Response(200, json={
+                "name": "projects/p/locations/l/queuedResources/qr1",
+                "tpu": created.get("tpu", {}),
+                "reservationName": "res9",
+                "state": {"state": "WAITING_FOR_RESOURCES"}})
+        raise AssertionError(f"unexpected {req.method} {path}")
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    c = CloudTPUQueuedResourcesClient(StaticTokenCredential("t"), "p", "l",
+                                      transport=FAST, http=http)
+    qr = await c.create(QueuedResource(name="qr1", accelerator_type="v5p-32",
+                                       reservation="res9", node_pool="np1"))
+    assert qr.state == "WAITING_FOR_RESOURCES"
+    assert qr.name == "qr1" and qr.node_pool == "np1"
