@@ -1,0 +1,16 @@
+# Controller image — the reference uses distroless static + CGO off
+# (Dockerfile, SURVEY.md §2a #16); the Python analog: slim base, deps baked,
+# non-root, no shell entrypoint surprises. The JAX workload half is NOT in
+# this image (it runs in the provisioned slice's pods, not the controller).
+FROM python:3.12-slim AS base
+
+WORKDIR /app
+RUN pip install --no-cache-dir httpx aiohttp pyyaml prometheus-client
+
+COPY gpu_provisioner_tpu/ ./gpu_provisioner_tpu/
+
+RUN useradd --uid 65532 --no-create-home controller
+USER 65532
+
+ENV PYTHONUNBUFFERED=1
+ENTRYPOINT ["python", "-m", "gpu_provisioner_tpu.operator"]

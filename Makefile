@@ -1,0 +1,81 @@
+# tpu-provisioner build/dev/deploy targets — the GKE analog of the
+# reference Makefile (az-mkaks/az-identity-perm/az-federated-credential/
+# az-patch-helm cluster bootstrap :63-118, unit-test :172, e2etests :178).
+
+PROJECT_ID    ?= $(shell gcloud config get-value project 2>/dev/null)
+LOCATION      ?= us-central2-b
+CLUSTER_NAME  ?= kaito-tpu
+GSA_NAME      ?= tpu-provisioner
+GSA_EMAIL     := $(GSA_NAME)@$(PROJECT_ID).iam.gserviceaccount.com
+NAMESPACE     ?= tpu-provisioner
+IMG_REPO      ?= ghcr.io/kaito-project/tpu-provisioner
+VERSION       ?= 0.1.0
+PY            ?= python
+
+.PHONY: help
+help: ## Show this help
+	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
+	  awk 'BEGIN {FS = ":.*?## "}; {printf "  %-24s %s\n", $$1, $$2}'
+
+## -------- test / bench ----------------------------------------------------
+
+.PHONY: unit-test
+unit-test: ## Unit tests (reference Makefile:171-175)
+	$(PY) -m pytest tests/ -q -m "not e2e"
+
+.PHONY: e2etests
+e2etests: ## e2e suite: real operator subprocess vs HTTP fakes (Makefile:177-187)
+	$(PY) -m pytest tests/e2e -q
+
+.PHONY: test
+test: ## Everything
+	$(PY) -m pytest tests/ -q
+
+.PHONY: bench
+bench: ## Headline benchmark JSON line
+	$(PY) bench.py
+
+## -------- image -----------------------------------------------------------
+
+.PHONY: docker-build
+docker-build: ## Build the controller image
+	docker build -t $(IMG_REPO):$(VERSION) .
+
+.PHONY: docker-push
+docker-push: docker-build ## Push the controller image
+	docker push $(IMG_REPO):$(VERSION)
+
+## -------- GKE cluster bootstrap (az-mkaks analog, Makefile:63-118) --------
+
+.PHONY: gke-mkcluster
+gke-mkcluster: ## Create a GKE cluster with workload identity enabled
+	gcloud container clusters create $(CLUSTER_NAME) \
+	  --project $(PROJECT_ID) --location $(LOCATION) \
+	  --workload-pool=$(PROJECT_ID).svc.id.goog \
+	  --num-nodes 1 --machine-type e2-standard-4
+
+.PHONY: gke-workload-identity
+gke-workload-identity: ## GSA + IAM + KSA binding (az-identity-perm + az-federated-credential analog)
+	gcloud iam service-accounts create $(GSA_NAME) --project $(PROJECT_ID) || true
+	gcloud projects add-iam-policy-binding $(PROJECT_ID) \
+	  --member "serviceAccount:$(GSA_EMAIL)" --role roles/container.admin
+	gcloud projects add-iam-policy-binding $(PROJECT_ID) \
+	  --member "serviceAccount:$(GSA_EMAIL)" --role roles/tpu.admin
+	gcloud iam service-accounts add-iam-policy-binding $(GSA_EMAIL) \
+	  --project $(PROJECT_ID) --role roles/iam.workloadIdentityUser \
+	  --member "serviceAccount:$(PROJECT_ID).svc.id.goog[$(NAMESPACE)/tpu-provisioner]"
+
+.PHONY: helm-install
+helm-install: ## Render values from gcloud and install the chart (az-patch-helm analog)
+	./hack/deploy/configure-helm-values.sh > /tmp/tpu-provisioner-values.yaml
+	helm upgrade --install tpu-provisioner charts/tpu-provisioner \
+	  --namespace $(NAMESPACE) --create-namespace \
+	  -f /tmp/tpu-provisioner-values.yaml
+
+## -------- release ---------------------------------------------------------
+
+.PHONY: release-manifest
+release-manifest: ## Stamp chart + pyproject versions (Makefile:192 analog)
+	sed -i 's/^version:.*/version: $(VERSION)/' charts/tpu-provisioner/Chart.yaml
+	sed -i 's/^appVersion:.*/appVersion: "$(VERSION)"/' charts/tpu-provisioner/Chart.yaml
+	sed -i 's/^version = .*/version = "$(VERSION)"/' pyproject.toml

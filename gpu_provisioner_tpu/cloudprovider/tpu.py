@@ -33,8 +33,10 @@ REPAIR_TOLERATION_SECONDS = 10 * 60
 
 
 class TPUCloudProvider:
-    def __init__(self, instances: InstanceProvider):
+    def __init__(self, instances: InstanceProvider,
+                 repair_toleration: float = REPAIR_TOLERATION_SECONDS):
         self.instances = instances
+        self.repair_toleration = repair_toleration
 
     def name(self) -> str:
         return PROVIDER_NAME
@@ -67,10 +69,10 @@ class TPUCloudProvider:
 
     def repair_policies(self) -> list[RepairPolicy]:
         return [
-            RepairPolicy("Ready", "False", REPAIR_TOLERATION_SECONDS),
-            RepairPolicy("Ready", "Unknown", REPAIR_TOLERATION_SECONDS),
+            RepairPolicy("Ready", "False", self.repair_toleration),
+            RepairPolicy("Ready", "Unknown", self.repair_toleration),
             # TPU extension: device-plugin-reported accelerator health.
-            RepairPolicy("AcceleratorHealthy", "False", REPAIR_TOLERATION_SECONDS),
+            RepairPolicy("AcceleratorHealthy", "False", self.repair_toleration),
         ]
 
     def get_supported_node_classes(self) -> list[type]:

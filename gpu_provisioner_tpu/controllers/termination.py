@@ -30,9 +30,9 @@ log = logging.getLogger("controllers.termination")
 
 
 class EvictionQueue:
-    """Rate-limited pod evictor (terminator/eviction.go:93-140). Evictions in
-    this in-process runtime are pod deletes; against a real apiserver the same
-    seam posts Eviction subresources."""
+    """Rate-limited pod evictor (terminator/eviction.go:93-140) over the
+    Client.evict seam: a plain delete in-process, the policy/v1 Eviction
+    subresource against a real apiserver (PDB-aware; 429s requeue)."""
 
     def __init__(self, client: Client, qps: float = 10.0):
         self.client = client
@@ -64,7 +64,7 @@ class EvictionQueue:
         while True:
             ns, name = await self._q.get()
             try:
-                await self.client.delete(Pod, name, ns)
+                await self.client.evict(name, ns)
             except NotFoundError:
                 self._queued.discard((ns, name))  # already gone — allow re-use
             except Exception as e:  # noqa: BLE001 — requeue on transient errors
@@ -105,6 +105,16 @@ class NodeTerminationController:
 
         await self._taint_disrupted(node)
         nc = await nodeclaim_for_node(self.client, node)
+
+        # Node-initiated teardown cascades to the owning NodeClaim (the
+        # reference e2e relies on this: deleting a Node unwinds everything,
+        # suite_test.go:252,529) — the claim's finalize then deletes the
+        # instance, which is what lets _instance_gone flip below.
+        if nc is not None and nc.metadata.deletion_timestamp is None:
+            try:
+                await self.client.delete(NodeClaim, nc.metadata.name)
+            except NotFoundError:
+                pass
 
         if not await self._instance_gone(node):
             if not self._grace_expired(nc):

@@ -42,6 +42,7 @@ class EnvtestOptions:
         termination_requeue=0.05, registration_requeue=0.05))
     termination: TerminationOptions = field(default_factory=lambda: TerminationOptions(
         requeue=0.05, instance_requeue=0.05))
+    repair_toleration: float = 0.5  # scaled-down 10-min reference toleration
     max_concurrent_reconciles: int = 64
 
 
@@ -64,7 +65,8 @@ class Env:
             self.cloud.nodepools, self.client,
             ProviderConfig(node_wait_interval=self.opts.node_wait_interval),
             queued=self.cloud.queuedresources)
-        self.cloudprovider = MetricsDecorator(TPUCloudProvider(self.provider))
+        self.cloudprovider = MetricsDecorator(TPUCloudProvider(
+            self.provider, repair_toleration=self.opts.repair_toleration))
         self.recorder = Recorder(self.client)
         controllers, self.eviction = build_controllers(
             self.client, self.cloudprovider, self.recorder,

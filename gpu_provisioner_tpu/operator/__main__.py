@@ -31,10 +31,13 @@ async def run_simulate(opts) -> int:
     env_opts = EnvtestOptions(
         create_latency=0.5, node_join_delay=0.1, node_ready_delay=0.2,
         gc_interval=opts.gc_interval_seconds,
-        leak_grace=opts.gc_leak_grace_seconds)
+        leak_grace=opts.gc_leak_grace_seconds,
+        repair_toleration=opts.repair_toleration_seconds)
     env_opts.lifecycle.liveness_enabled = opts.liveness_enabled
     env_opts.lifecycle.launch_timeout = opts.launch_timeout_seconds
     env_opts.lifecycle.registration_timeout = opts.registration_timeout_seconds
+    env_opts.lifecycle.termination_requeue = opts.termination_requeue_seconds
+    env_opts.termination.instance_requeue = opts.instance_requeue_seconds
     env_opts.max_concurrent_reconciles = opts.max_concurrent_reconciles
 
     async with Env(env_opts) as env:
@@ -108,8 +111,7 @@ async def run_real(opts) -> int:
     from ..runtime.rest import KubeConnection, RestClient
 
     try:
-        cfg = build_config()
-        cfg.validate()
+        cfg = build_config()  # validates before returning
     except ConfigError as e:
         # fail fast with an actionable message (pkg/operator/operator.go:46)
         print(f"error: {e}", file=sys.stderr)
@@ -137,7 +139,8 @@ async def run_real(opts) -> int:
         cred, cfg.project_id, cfg.location,
         endpoint=cfg.tpu_api_endpoint or gcprest.TPU_ENDPOINT)
     provider = InstanceProvider(nodepools, kube, queued=queued)
-    cloudprovider = MetricsDecorator(TPUCloudProvider(provider))
+    cloudprovider = MetricsDecorator(TPUCloudProvider(
+        provider, repair_toleration=opts.repair_toleration_seconds))
 
     from ..controllers.termination import TerminationOptions
 

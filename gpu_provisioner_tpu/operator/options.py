@@ -34,6 +34,7 @@ class Options:
     gc_leak_grace_seconds: float = 30.0
     termination_requeue_seconds: float = 5.0   # lifecycle controller.go:246
     instance_requeue_seconds: float = 5.0      # node termination await-instance
+    repair_toleration_seconds: float = 600.0   # cloudprovider.go:103-116
     max_concurrent_reconciles: int = 64
     simulate: bool = False
     simulate_claims: int = 0
@@ -74,6 +75,8 @@ def parse_options(argv=None, env=None) -> Options:
             e.get("TERMINATION_REQUEUE_SECONDS", "5")),
         instance_requeue_seconds=float(
             e.get("INSTANCE_REQUEUE_SECONDS", "5")),
+        repair_toleration_seconds=float(
+            e.get("REPAIR_TOLERATION_SECONDS", "600")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)

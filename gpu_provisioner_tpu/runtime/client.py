@@ -50,6 +50,7 @@ class Client(Protocol):
     async def update(self, obj: Object) -> Object: ...
     async def update_status(self, obj: Object) -> Object: ...
     async def delete(self, cls: type, name: str, namespace: str = "") -> None: ...
+    async def evict(self, name: str, namespace: str = "") -> None: ...
     def watch(self, cls: type) -> "Watch": ...
 
 
@@ -125,6 +126,12 @@ class InMemoryClient:
 
     async def delete(self, cls, name, namespace=""):
         return await _translate(self.store.delete)(cls, name, namespace)
+
+    async def evict(self, name, namespace=""):
+        """Pod eviction: a plain delete in-process; the REST client posts the
+        Eviction subresource instead (terminator/eviction.go:93-140)."""
+        from ..apis.core import Pod
+        return await _translate(self.store.delete)(Pod, name, namespace)
 
     def watch(self, cls) -> Watch:
         return Watch(self.store, cls)

@@ -9,6 +9,7 @@ a count bump, like the apiserver's event aggregation.
 from __future__ import annotations
 
 import hashlib
+import logging
 
 from ..apis.core import Event, ObjectReference
 from ..apis.meta import Object, ObjectMeta
@@ -18,6 +19,8 @@ from .client import Client, NotFoundError
 NORMAL = "Normal"
 WARNING = "Warning"
 
+log = logging.getLogger("events")
+
 
 class Recorder:
     def __init__(self, client: Client, namespace: str = "default"):
@@ -25,6 +28,17 @@ class Recorder:
         self.namespace = namespace
 
     async def publish(self, obj: Object, etype: str, reason: str, message: str) -> None:
+        """Best-effort, like client-go's recorder: an event that cannot be
+        written (RBAC, conflicts, apiserver hiccups) must never fail the
+        reconcile that emitted it."""
+        try:
+            await self._publish(obj, etype, reason, message)
+        except Exception as e:  # noqa: BLE001 — events are advisory
+            log.warning("dropping event %s/%s for %s: %s",
+                        etype, reason, obj.metadata.name, e)
+
+    async def _publish(self, obj: Object, etype: str, reason: str,
+                       message: str) -> None:
         h = hashlib.sha1(f"{obj.metadata.uid}/{reason}".encode()).hexdigest()[:16]
         name = f"{obj.metadata.name}.{h}"
         ref = ObjectReference(kind=obj.KIND, namespace=obj.metadata.namespace,

@@ -37,16 +37,23 @@ log = logging.getLogger("rest")
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 TOKEN_REREAD_SECONDS = 60.0
 
-# Irregular kind → resource plurals would go here; everything this
-# controller touches pluralizes regularly.
+# Irregular kind → resource plurals would go here; the only wrinkle among
+# registered kinds is sibilant endings (KaitoNodeClass → kaitonodeclasses).
 _PLURALS: dict[str, str] = {}
+
+
+def _pluralize(kind: str) -> str:
+    lower = kind.lower()
+    if lower.endswith(("s", "x", "z", "ch", "sh")):
+        return lower + "es"
+    return lower + "s"
 
 
 def resource_path(cls: type, namespace: str = "", name: str = "") -> str:
     """Build the API path for a registered kind."""
     gv = cls.API_VERSION
     base = f"/api/{gv}" if "/" not in gv else f"/apis/{gv}"
-    plural = _PLURALS.get(cls.KIND, cls.KIND.lower() + "s")
+    plural = _PLURALS.get(cls.KIND, _pluralize(cls.KIND))
     if cls.NAMESPACED and namespace:
         base = f"{base}/namespaces/{namespace}"
     path = f"{base}/{plural}"
@@ -229,6 +236,17 @@ class RestClient:
     async def delete(self, cls: type, name: str, namespace: str = "") -> None:
         await self._req("delete", "DELETE", resource_path(cls, namespace, name))
 
+    async def evict(self, name: str, namespace: str = "") -> None:
+        """POST the policy/v1 Eviction subresource — honors PodDisruptionBudgets
+        server-side, which a bare pod DELETE would bypass (and the chart's RBAC
+        grants pods/eviction create, not pods delete)."""
+        from ..apis.core import Pod
+        await self._req(
+            "evict", "POST",
+            resource_path(Pod, namespace, name) + "/eviction",
+            json={"apiVersion": "policy/v1", "kind": "Eviction",
+                  "metadata": {"name": name, "namespace": namespace}})
+
     def watch(self, cls: type) -> "RestWatch":
         return RestWatch(self, cls)
 
@@ -240,6 +258,11 @@ class RestWatch:
     """ListAndWatch with re-list on breakage. Same surface as runtime.Watch."""
 
     RECONNECT_BACKOFF = 1.0
+    # Server-side watch window + a slightly longer client read timeout: a
+    # half-open connection (LB blackhole, node power loss) then surfaces as
+    # ReadTimeout → the normal re-list path, instead of hanging forever.
+    WATCH_TIMEOUT_SECONDS = 300
+    READ_TIMEOUT_SECONDS = 330.0
 
     def __init__(self, client: RestClient, cls: type):
         self.client = client
@@ -297,13 +320,15 @@ class RestWatch:
         return body.get("metadata", {}).get("resourceVersion", "")
 
     async def _stream(self, rv: str) -> str:
-        params = {"watch": "true", "allowWatchBookmarks": "true"}
+        params = {"watch": "true", "allowWatchBookmarks": "true",
+                  "timeoutSeconds": str(self.WATCH_TIMEOUT_SECONDS)}
         if rv:
             params["resourceVersion"] = rv
         headers = await self.client._headers()
+        timeout = httpx.Timeout(10.0, read=self.READ_TIMEOUT_SECONDS)
         async with self.client.http.stream(
                 "GET", resource_path(self.cls), params=params,
-                headers=headers, timeout=None) as resp:
+                headers=headers, timeout=timeout) as resp:
             if resp.status_code >= 400:
                 raise ClientError(f"watch: HTTP {resp.status_code}")
             async for line in resp.aiter_lines():

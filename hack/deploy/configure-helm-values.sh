@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Render chart values from the active gcloud context — the analog of the
+# reference's hack/deploy/configure-helm-values.sh, which envsubst-renders
+# gpu-provisioner-values-template.yaml from `az` CLI output.
+set -euo pipefail
+
+PROJECT_ID="${PROJECT_ID:-$(gcloud config get-value project 2>/dev/null)}"
+LOCATION="${LOCATION:-$(gcloud config get-value compute/zone 2>/dev/null)}"
+CLUSTER_NAME="${CLUSTER_NAME:-$(gcloud config get-value container/cluster 2>/dev/null)}"
+GSA_EMAIL="${GSA_EMAIL:-tpu-provisioner@${PROJECT_ID}.iam.gserviceaccount.com}"
+
+for var in PROJECT_ID LOCATION CLUSTER_NAME; do
+  if [ -z "${!var}" ]; then
+    echo "error: $var is unset and not derivable from gcloud config" >&2
+    exit 1
+  fi
+done
+
+cat <<EOF
+serviceAccount:
+  annotations:
+    iam.gke.io/gcp-service-account: ${GSA_EMAIL}
+controller:
+  env:
+    - name: PROJECT_ID
+      value: "${PROJECT_ID}"
+    - name: LOCATION
+      value: "${LOCATION}"
+    - name: CLUSTER_NAME
+      value: "${CLUSTER_NAME}"
+    - name: DEPLOYMENT_MODE
+      value: "managed"
+    - name: LOG_LEVEL
+      value: "info"
+    - name: FEATURE_GATES
+      value: "NodeRepair=true"
+EOF

@@ -55,6 +55,9 @@ class FakeKubeAPIServer:
             self.app.router.add_route(
                 "PUT", base + "/namespaces/{ns}/{plural}/{name}/status",
                 self._status)
+            self.app.router.add_route(
+                "POST", base + "/namespaces/{ns}/{plural}/{name}/eviction",
+                self._evict)
         self.runner: Optional[web.AppRunner] = None
         self.port = 0
 
@@ -150,6 +153,14 @@ class FakeKubeAPIServer:
         except StoreConflict as e:
             return web.Response(status=409, text=str(e))
         return web.Response(status=405)
+
+    async def _evict(self, req: web.Request) -> web.Response:
+        cls, ns, name = self._parse(req)
+        try:
+            self.store.delete(cls, name, ns)
+        except StoreNotFound as e:
+            return web.Response(status=404, text=str(e))
+        return web.json_response({"status": "Success"}, status=201)
 
     async def _status(self, req: web.Request) -> web.Response:
         cls, ns, name = self._parse(req)

@@ -1,0 +1,198 @@
+"""The full e2e suite: 8 reference specs + TPU-specific extensions.
+
+Mirrors test/e2e/suites/suite_test.go (:49 workspace provision, :117
+ragengine provision, :183 teardown via NodeClaim delete — covered by
+test_provisioning.py, :252/:529 teardown via Node delete, :321 nodeclass
+provisioning, :387 negative foreign-nodeclass, :452 image family via
+annotation) plus specs the reference cannot have: stockout →
+InsufficientCapacity claim deletion, leaked-instance GC, node auto-repair,
+and multi-slice DCN groups. Each spec runs the REAL operator subprocess
+against the HTTP fakes (env.Environment).
+"""
+
+import asyncio
+
+import pytest
+
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.karpenter import (LAUNCHED, NodeClaim,
+                                                NodeClassRef)
+from gpu_provisioner_tpu.apis.kaito import KaitoNodeClass
+from gpu_provisioner_tpu.apis.meta import ObjectMeta
+from gpu_provisioner_tpu.apis.serde import now
+from gpu_provisioner_tpu.catalog import lookup as catalog_lookup
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.providers.gcp import APIError, NodePool, NodePoolConfig
+from gpu_provisioner_tpu.providers.instance import ts_label
+from gpu_provisioner_tpu.apis.core import Node
+
+from ..conftest import async_test
+from .env import Environment
+
+pytestmark = pytest.mark.e2e
+
+
+@async_test
+async def test_provision_via_workspace_label_single_host(tmp_path):
+    """suite_test.go:49 — plus TPU capacity/topology assertions."""
+    async with Environment(tmp_path) as env:
+        await env.client.create(make_nodeclaim("ws1", "tpu-v5e-8",
+                                               workspace="myws"))
+        nc = await env.expect_nodeclaim_ready("ws1")
+        (node,) = await env.expect_node_count(1)
+        assert node.status.capacity[wk.TPU_RESOURCE_NAME] == "8"
+        assert node.metadata.labels[wk.GKE_TPU_ACCELERATOR_LABEL] == \
+            "tpu-v5-lite-podslice"
+        assert node.metadata.labels[wk.KAITO_WORKSPACE_LABEL] == "myws"
+        assert nc.status.node_name == node.metadata.name
+
+
+@async_test
+async def test_provision_via_ragengine_label(tmp_path):
+    """suite_test.go:117 — ragengine ownership path."""
+    async with Environment(tmp_path) as env:
+        nc = make_nodeclaim("rag0", "tpu-v5e-8")
+        del nc.metadata.labels[wk.KAITO_WORKSPACE_LABEL]
+        nc.metadata.labels[wk.KAITO_RAGENGINE_LABEL] = "rag"
+        nc.spec.node_class_ref = None  # ragengine label alone must qualify
+        await env.client.create(nc)
+        await env.expect_nodeclaim_ready("rag0")
+        (node,) = await env.expect_node_count(1)
+        assert node.metadata.labels[wk.KAITO_RAGENGINE_LABEL] == "rag"
+
+
+@async_test
+async def test_teardown_via_node_delete(tmp_path):
+    """suite_test.go:252,529 — deleting the Node unwinds claim + pool."""
+    async with Environment(tmp_path) as env:
+        await env.client.create(make_nodeclaim("wsn", "tpu-v5e-8"))
+        await env.expect_nodeclaim_ready("wsn")
+        (node,) = await env.expect_node_count(1)
+
+        await env.client.delete(Node, node.metadata.name)
+        await env.expect_gone(NodeClaim, "wsn")
+        await env.expect_node_count(0)
+
+        async def pools_gone():
+            return not await env.cloud.nodepools.list() or None
+        await env.eventually(pools_gone, what="node pools cleaned up")
+
+
+@async_test
+async def test_nodeclass_provisioning(tmp_path):
+    """suite_test.go:321 — NodeClassRef alone (no kaito labels) qualifies."""
+    async with Environment(tmp_path) as env:
+        await env.client.create(KaitoNodeClass(
+            metadata=ObjectMeta(name="default")))
+        nc = make_nodeclaim("klass0", "tpu-v5e-8")
+        del nc.metadata.labels[wk.KAITO_WORKSPACE_LABEL]
+        assert nc.spec.node_class_ref.kind == "KaitoNodeClass"
+        await env.client.create(nc)
+        await env.expect_nodeclaim_ready("klass0")
+
+
+@async_test
+async def test_foreign_nodeclass_is_ignored(tmp_path):
+    """suite_test.go:387 — a non-kaito NodeClaim must NOT provision."""
+    async with Environment(tmp_path) as env:
+        nc = make_nodeclaim("foreign0", "tpu-v5e-8")
+        del nc.metadata.labels[wk.KAITO_WORKSPACE_LABEL]
+        nc.spec.node_class_ref = NodeClassRef(
+            group="karpenter.azure.com", kind="AKSNodeClass", name="default")
+        await env.client.create(nc)
+
+        await asyncio.sleep(3)  # several reconcile periods
+        fresh = await env.client.get(NodeClaim, "foreign0")
+        assert not fresh.status_conditions.is_true(LAUNCHED)
+        assert not await env.cloud.nodepools.list()
+        assert await env.client.list(Node) == []
+
+
+@async_test
+async def test_image_family_annotation(tmp_path):
+    """suite_test.go:452 — AzureLinux-annotation analog: node image family
+    → GKE imageType (determineOSSKU, instance.go:416-441)."""
+    async with Environment(tmp_path) as env:
+        await env.client.create(make_nodeclaim(
+            "img0", "tpu-v5e-8",
+            annotations={wk.KAITO_NODE_IMAGE_FAMILY_ANNOTATION: "ubuntu"}))
+        await env.expect_nodeclaim_ready("img0")
+        pool = await env.cloud.nodepools.get("img0")
+        assert pool.config.image_type == "UBUNTU_CONTAINERD"
+
+
+@async_test
+async def test_stockout_deletes_claim(tmp_path):
+    """No reference analog on AKS; BASELINE hard part 2 — RESOURCE_EXHAUSTED
+    must surface as InsufficientCapacity and delete the claim
+    (launch.go:84-109 behavior), never retry-loop."""
+    async with Environment(tmp_path) as env:
+        env.cloud.nodepools.fail(
+            "begin_create", APIError("no v5e capacity in zone", code=429),
+            times=100)
+        await env.client.create(make_nodeclaim("stock0", "tpu-v5e-8"))
+        await env.expect_gone(NodeClaim, "stock0")
+        assert not await env.cloud.nodepools.list()
+
+
+@async_test
+async def test_gc_deletes_leaked_instance(tmp_path):
+    """pkg/controllers/instance/garbagecollection readme scenario: a slice
+    whose NodeClaim no longer exists is deleted after the leak grace."""
+    async with Environment(tmp_path) as env:
+        shape = catalog_lookup("tpu-v5e-8")
+        leaked = NodePool(
+            name="leaked0",
+            config=NodePoolConfig(
+                machine_type=shape.machine_type,
+                labels={wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,
+                        wk.KAITO_CREATION_TIMESTAMP_LABEL: ts_label(now()),
+                        **shape.node_labels(slice_id="leaked0")}),
+            initial_node_count=1)
+        op = await env.cloud.nodepools.begin_create(leaked)
+        await op.result()
+
+        async def gone():
+            names = [p.name for p in await env.cloud.nodepools.list()]
+            return "leaked0" not in names or None
+        await env.eventually(gone, what="leaked pool collected")
+        # its orphan nodes are reaped too (controller.go:99-120)
+        await env.expect_node_count(0)
+
+
+@async_test
+async def test_node_repair_replaces_unhealthy(tmp_path):
+    """§3.5 — NodeReady=False past toleration deletes the NodeClaim."""
+    async with Environment(
+            tmp_path, extra_env={"REPAIR_TOLERATION_SECONDS": "1"}) as env:
+        await env.client.create(make_nodeclaim("sick0", "tpu-v5e-8"))
+        await env.expect_nodeclaim_ready("sick0")
+        (node,) = await env.expect_node_count(1)
+
+        # the "kubelet" reports NotReady
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                c.status = "False"
+                c.reason = "KubeletNotReady"
+                c.last_transition_time = now()
+        await env.client.update_status(node)
+
+        await env.expect_gone(NodeClaim, "sick0")
+
+
+@async_test
+async def test_multislice_group_provisions_n_slices(tmp_path):
+    """BASELINE config 5: 4× v5e-16 NodeClaims in one DCN slice group."""
+    async with Environment(tmp_path) as env:
+        for i in range(4):
+            nc = make_nodeclaim(f"slice{i}", "tpu-v5e-16",
+                                labels={wk.TPU_SLICE_GROUP_LABEL: "dpgroup"})
+            await env.client.create(nc)
+        for i in range(4):
+            await env.expect_nodeclaim_ready(f"slice{i}", timeout=60)
+        nodes = await env.expect_node_count(8)  # 4 slices × 2 hosts
+        groups = {n.metadata.labels.get(wk.TPU_SLICE_GROUP_LABEL)
+                  for n in nodes}
+        assert groups == {"dpgroup"}
+        pools = await env.cloud.nodepools.list()
+        assert len(pools) == 4

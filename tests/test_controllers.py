@@ -163,11 +163,7 @@ async def test_nodeclaim_gc_reaps_vanished_instance():
 
 @async_test
 async def test_repair_unhealthy_node_replaces_nodeclaim():
-    async with Env() as env:
-        # shrink the toleration so the test runs in milliseconds
-        env.cloudprovider.inner.repair_policies = lambda: [
-            __import__("gpu_provisioner_tpu.cloudprovider.types",
-                       fromlist=["RepairPolicy"]).RepairPolicy("Ready", "False", 0.1)]
+    async with Env(EnvtestOptions(repair_toleration=0.1)) as env:
         await env.client.create(make_nodeclaim("sick"))
         await env.wait_ready("sick")
         node = await env.client.get(Node, "gke-kaito-sick-w0")
