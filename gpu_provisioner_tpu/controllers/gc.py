@@ -127,7 +127,15 @@ class NodeClaimGCController:
         for nc in await list_managed(self.client):
             if nc.metadata.deletion_timestamp is not None:
                 continue
-            if not nc.status_conditions.is_true(REGISTERED):
+            reg = nc.status_conditions.get(REGISTERED)
+            if reg is None or reg.status != "True":
+                continue
+            # Same grace the instance GC applies to fresh pools: a claim that
+            # registered after the cloud list snapshot was taken would look
+            # "vanished" for one pass — never reap inside the grace window.
+            if (reg.last_transition_time is not None
+                    and (now() - reg.last_transition_time).total_seconds()
+                    <= self.opts.leak_grace):
                 continue
             if not nc.status.provider_id or nc.status.provider_id in cloud_ids:
                 continue

@@ -42,7 +42,10 @@ class EnvtestOptions:
         termination_requeue=0.05, registration_requeue=0.05))
     termination: TerminationOptions = field(default_factory=lambda: TerminationOptions(
         requeue=0.05, instance_requeue=0.05))
-    repair_toleration: float = 0.5  # scaled-down 10-min reference toleration
+    # Scaled-down reference toleration (10 min → 30 s): must stay well above
+    # simulated node-ready lag under load or repair reaps claims mid-launch;
+    # repair tests shrink it explicitly.
+    repair_toleration: float = 30.0
     max_concurrent_reconciles: int = 64
 
 

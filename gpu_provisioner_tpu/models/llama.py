@@ -46,6 +46,8 @@ class LlamaConfig:
     dtype: str = "bfloat16"        # activation / matmul dtype
     param_dtype: str = "float32"   # master weights
     remat: bool = False            # jax.checkpoint each block (HBM ↔ FLOPs)
+    attn_impl: str = "dense"       # "dense" | "flash" (pallas kernel; falls
+                                   # back to dense off-TPU / non-tiling shapes)
 
     @property
     def head_dim(self) -> int:
@@ -164,7 +166,11 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     sequence axis is sharded.
     """
     if attn_fn is None:
-        attn_fn = dense_attention
+        if cfg.attn_impl == "flash":
+            from ..ops import flash_attention
+            attn_fn = flash_attention
+        else:
+            attn_fn = dense_attention
     ad = cfg.act_dtype
     B, S = tokens.shape
     if positions is None:

@@ -33,15 +33,23 @@ def default_optimizer():
     return optax.adamw(3e-4, weight_decay=0.1)
 
 
-def make_attn_fn(mesh) -> Callable:
-    """Ring attention over ``seq`` when that axis is sharded, else dense."""
-    if mesh.shape[AXIS_SEQ] == 1:
-        return dense_attention
+def make_attn_fn(mesh, impl: str = "dense") -> Callable:
+    """Attention for the mesh: ring over ``seq`` when that axis is sharded;
+    otherwise the pallas flash kernel (impl="flash") or dense, shard_mapped
+    so each device runs the kernel on its local (batch, head) shard."""
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
-    return jax.shard_map(
-        partial(ring_attention, axis_name=AXIS_SEQ),
-        mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=qkv_spec, check_vma=False)
+    if mesh.shape[AXIS_SEQ] > 1:
+        return jax.shard_map(
+            partial(ring_attention, axis_name=AXIS_SEQ),
+            mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec, check_vma=False)
+    if impl == "flash":
+        from ..ops import flash_attention
+        return jax.shard_map(
+            flash_attention, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec, check_vma=False)
+    return dense_attention
 
 
 def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None):
@@ -77,7 +85,7 @@ def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
     """
     if optimizer is None:
         optimizer = default_optimizer()
-    attn_fn = make_attn_fn(mesh)
+    attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl)
 
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(loss_fn)(

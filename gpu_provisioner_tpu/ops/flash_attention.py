@@ -1,0 +1,238 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the flagship model, written for the hardware (per
+/opt/skills/guides/pallas_guide.md): the S×S score matrix never
+materializes, all matmuls hit the MXU with fp32 accumulation, and two
+variants trade HBM traffic against VMEM:
+
+- **resident** (K/V ≤ RESIDENT_KV_BUDGET in VMEM): one K/V DMA per
+  (batch·head, q-block) grid cell, inner fori_loop over tiles with the
+  causal loop bound pruned — fastest at short/medium S;
+- **streaming** (longer S): grid = (batch·head, q-blocks, kv-blocks), one
+  (block_k, D) K/V tile per grid step with the flash running-max/
+  denominator in VMEM scratch across the kv dimension — VMEM use is
+  O(block), independent of S, so 32k+ context runs where the dense path
+  cannot even compile.
+
+GQA costs no memory: the KV BlockSpec index_map points q-head ``bh`` at
+kv-head ``bh // group`` — no repeat materialization.
+
+Backward pass: flash forward + dense recompute backward via custom_vjp —
+exact gradients, with the dense memory cost paid only inside the backward.
+
+Falls back to the lax dense path when S doesn't tile into the (aligned)
+block sizes; ``interpret=True`` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel.ring import dense_attention
+
+NEG_INF = -1.0e30
+DEFAULT_BLOCK = 128
+
+
+# K+V bytes (in input dtype) we allow resident in VMEM before switching to
+# the streaming grid: bf16 S·D ≤ 6MB/2/2 → e.g. S=12288 @ D=128 still resident.
+RESIDENT_KV_BUDGET = 6 * 1024 * 1024
+
+
+def _kernel_resident(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+                     seq_len, scale, causal):
+    """Whole-K/V-in-VMEM variant: one DMA of K/V per (bh, q-block), inner
+    fori_loop over tiles. Fastest at short/medium S (fewer HBM round trips,
+    causal loop-bound pruning); VMEM-bounded, so only used under budget."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                      # [BQ, D]
+    if causal:
+        n_blocks = (qi * block_q + block_q - 1) // block_k + 1
+    else:
+        n_blocks = seq_len // block_k
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            block_q, block_k, scale, causal):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # whole block above the causal diagonal → no compute
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)                  # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)        # fully-masked rows
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+
+    # [B, S, H, D] → [B*H, S, D] so each grid cell owns one head's sequence
+    qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
+
+    # bh = b*Hq + h → kv row b*Hkv + h//group == bh // group (Hq = Hkv·group)
+    kv_bytes = 2 * S * D * jnp.dtype(q.dtype).itemsize
+    if kv_bytes <= RESIDENT_KV_BUDGET:
+        kernel = functools.partial(
+            _kernel_resident, block_q=block_q, block_k=block_k, seq_len=S,
+            scale=scale, causal=causal)
+        out = pl.pallas_call(
+            kernel,
+            grid=(B * Hq, S // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda bh, qi: (bh, qi, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+            interpret=interpret,
+        )(qf, kf, vf)
+        return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _kernel, block_q=block_q, block_k=block_k, scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * Hq, S // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, kj, g=group: (bh // g, kj, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, kj, g=group: (bh // g, kj, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal,
+                                           scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
+                    block_q: int = DEFAULT_BLOCK, block_k: int = DEFAULT_BLOCK,
+                    interpret: bool = None):
+    """Drop-in for dense_attention: q [B,S,Hq,D], k/v [B,S,Hkv,D] → [B,S,Hq,D].
+
+    Takes the Pallas kernel only when S tiles exactly into the given
+    (hardware-aligned) block sizes and GQA divides evenly; any other shape
+    gets the dense path so callers never have to think about it.
+    """
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    tiles = (S % block_q == 0 and S % block_k == 0 and Hq % Hkv == 0)
+    if not tiles:
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    return _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret)

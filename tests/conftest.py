@@ -30,14 +30,19 @@ import functools
 import pytest
 
 
-def async_test(fn):
+def async_test(fn, timeout: float = 60):
     """Run an async test function to completion (no pytest-asyncio here)."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=timeout))
 
     return wrapper
+
+
+def async_test_long(fn):
+    """e2e wrapper: subprocess + HTTP + generous Eventually timeouts."""
+    return async_test(fn, timeout=300)
 
 
 @pytest.fixture

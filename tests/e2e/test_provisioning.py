@@ -13,7 +13,7 @@ from gpu_provisioner_tpu.apis.core import Node
 from gpu_provisioner_tpu.apis.karpenter import NodeClaim
 from gpu_provisioner_tpu.fake import make_nodeclaim
 
-from ..conftest import async_test
+from ..conftest import async_test_long as async_test
 from .env import Environment, Monitor
 
 pytestmark = pytest.mark.e2e

@@ -26,7 +26,7 @@ from gpu_provisioner_tpu.providers.gcp import APIError, NodePool, NodePoolConfig
 from gpu_provisioner_tpu.providers.instance import ts_label
 from gpu_provisioner_tpu.apis.core import Node
 
-from ..conftest import async_test
+from ..conftest import async_test_long as async_test
 from .env import Environment
 
 pytestmark = pytest.mark.e2e
