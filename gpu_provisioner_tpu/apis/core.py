@@ -161,3 +161,26 @@ class Event(Object):
     type: str = "Normal"
     count: int = 1
     last_timestamp: Optional[datetime] = None
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[datetime] = None
+    renew_time: Optional[datetime] = None
+    lease_transitions: int = 0
+
+
+@register_kind
+@dataclass
+class Lease(Object):
+    """coordination.k8s.io Lease — leader election (the reference's manager
+    elects via Lease, vendor/.../operator/operator.go:157-164; disabled by
+    default per options.go:117 but implemented for multi-replica deploys)."""
+
+    API_VERSION: ClassVar[str] = "coordination.k8s.io/v1"
+    KIND: ClassVar[str] = "Lease"
+    NAMESPACED: ClassVar[bool] = True
+
+    spec: LeaseSpec = field(default_factory=LeaseSpec)

@@ -136,8 +136,9 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _block(x, lp, cfg: LlamaConfig, positions, attn_fn):
-    """One decoder block. x: [B, S, D], lp: this layer's param slice."""
+def _block_attention_half(x, lp, cfg: LlamaConfig, positions, attn_fn):
+    """Norm → QKV → rope → attention → residual (shared with models/moe.py,
+    which swaps only the FFN half)."""
     B, S, D = x.shape
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     ad = cfg.act_dtype
@@ -148,8 +149,13 @@ def _block(x, lp, cfg: LlamaConfig, positions, attn_fn):
     v = (h @ lp["wv"].astype(ad)).reshape(B, S, Hkv, Dh)
     q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
     o = attn_fn(q, k, v).reshape(B, S, Hq * Dh)
-    x = x + o @ lp["wo"].astype(ad)
+    return x + o @ lp["wo"].astype(ad)
 
+
+def _block(x, lp, cfg: LlamaConfig, positions, attn_fn):
+    """One decoder block. x: [B, S, D], lp: this layer's param slice."""
+    ad = cfg.act_dtype
+    x = _block_attention_half(x, lp, cfg, positions, attn_fn)
     h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
     gated = jax.nn.silu(h @ lp["w_gate"].astype(ad)) * (h @ lp["w_up"].astype(ad))
     return x + gated @ lp["w_down"].astype(ad)

@@ -1,0 +1,242 @@
+"""Mixtral-style sparse Mixture-of-Experts with expert parallelism.
+
+TPU-first formulation (GShard/Switch dispatch — the canonical XLA MoE):
+no gather/scatter or dynamic shapes. Routing builds a dense one-hot
+dispatch tensor [B, S, E, C] (capacity C per expert) and the whole layer is
+three einsums — dispatch, expert FFN, combine — so GSPMD inserts the
+all-to-alls when tokens are sharded over (slice, data) and expert weights
+over the ``expert`` mesh axis (PartitionSpec("expert", None, "model"):
+ep × tp compose). Overflow tokens beyond capacity are dropped (standard
+Switch behavior); the residual stream carries them unchanged.
+
+Reference parity note: the reference provisions capacity for KAITO model
+workspaces; Mixtral-class MoE is in that family. Nothing in the reference
+to cite — this is workload-side scope the TPU build adds (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import AXIS_EXPERT, AXIS_MODEL
+from .llama import LlamaConfig, _rmsnorm
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2     # top-k routing (Mixtral: 2)
+    capacity_factor: float = 1.25  # C = factor · k · S / E
+    router_z_loss: float = 1e-3    # stabilizes router logits (ST-MoE)
+
+
+PRESETS_MOE = {
+    "tiny-moe": MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                          n_experts=4, experts_per_token=2),
+    "mixtral-ish": MoEConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+                             hidden_dim=5504, n_experts=8),
+}
+
+
+def init_moe_params(key, cfg: MoEConfig) -> dict:
+    """Per-layer MoE FFN params, stacked [L, ...] like the dense blocks."""
+    pd = jnp.dtype(cfg.param_dtype)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.n_experts
+    ks = jax.random.split(key, 4)
+
+    def norm(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5)).astype(pd)
+
+    return {
+        "router": norm(ks[0], (L, D, E), D),
+        "w_gate": norm(ks[1], (L, E, D, F), D),
+        "w_up": norm(ks[2], (L, E, D, F), D),
+        "w_down": norm(ks[3], (L, E, F, D), F),
+    }
+
+
+def moe_param_specs() -> dict:
+    """Experts over ``expert``, inner width over ``model`` (ep × tp)."""
+    E, M = AXIS_EXPERT, AXIS_MODEL
+    return {
+        "router": P(None, None, None),
+        "w_gate": P(None, E, None, M),
+        "w_up": P(None, E, None, M),
+        "w_down": P(None, E, M, None),
+    }
+
+
+def capacity(cfg: MoEConfig, seq_len: int) -> int:
+    c = int(cfg.capacity_factor * cfg.experts_per_token * seq_len
+            / cfg.n_experts)
+    return max(1, c)
+
+
+def route(logits, k: int, cap: int):
+    """Top-k routing → (dispatch [B,S,E,C] one-hot, combine [B,S,E,C]).
+
+    Position-in-expert via cumulative sum over the flattened (s, k) choice
+    order — deterministic, shape-static, XLA-friendly. Tokens past an
+    expert's capacity are dropped.
+    """
+    B, S, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [B,S,E]
+    gate_vals, gate_idx = lax.top_k(probs, k)                     # [B,S,k]
+    # renormalize the k gates so combine weights sum to 1 per token
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)       # [B,S,k,E]
+    # choice order: (s, k) flattened → earlier tokens/choices claim slots first
+    flat = onehot.reshape(B, S * k, E)
+    pos = jnp.cumsum(flat, axis=1) - flat                          # [B,S*k,E]
+    pos = pos.reshape(B, S, k, E)
+    within = (pos < cap) & (onehot > 0)                            # [B,S,k,E]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                            dtype=jnp.float32) * within[..., None]
+    # [B,S,k,E,C] → fold the k choices
+    dispatch = jnp.sum(pos_oh, axis=2)                             # [B,S,E,C]
+    combine = jnp.sum(pos_oh * gate_vals[..., None, None]
+                      * onehot[..., None], axis=2)                 # [B,S,E,C]
+    return dispatch, combine
+
+
+def moe_ffn(x, lp: dict, cfg: MoEConfig):
+    """One MoE FFN layer. x: [B, S, D] → [B, S, D] (+ aux losses dict)."""
+    B, S, D = x.shape
+    ad = cfg.act_dtype
+    cap = capacity(cfg, S)
+    logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    dispatch, combine = route(logits, cfg.experts_per_token, cap)
+
+    # dispatch → [E, B, C, D]: GSPMD turns this into the all-to-all when
+    # x is batch-sharded and the expert dim is mesh-sharded
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(ad), x)
+    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in,
+                               lp["w_gate"].astype(ad)))
+    h = h * jnp.einsum("ebcd,edf->ebcf", expert_in, lp["w_up"].astype(ad))
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, lp["w_down"].astype(ad))
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(ad), expert_out)
+
+    # load-balance aux loss (Switch §2.2) + router z-loss (ST-MoE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac_tokens = jnp.mean(dispatch.sum(-1), axis=(0, 1))          # [E]
+    frac_probs = jnp.mean(probs, axis=(0, 1))                      # [E]
+    lb_loss = cfg.n_experts * jnp.sum(frac_tokens * frac_probs)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return out, {"load_balance": lb_loss, "router_z": z_loss}
+
+
+def moe_block(x, lp_dense: dict, lp_moe: dict, cfg: MoEConfig, positions,
+              attn_fn):
+    """Decoder block with the dense FFN swapped for the MoE FFN."""
+    from .llama import _block_attention_half
+
+    x = _block_attention_half(x, lp_dense, cfg, positions, attn_fn)
+    h = _rmsnorm(x, lp_dense["ln_mlp"], cfg.norm_eps)
+    ffn_out, aux = moe_ffn(h, lp_moe, cfg)
+    return x + ffn_out, aux
+
+
+# --- full model ------------------------------------------------------------
+
+def init_moe_model(key, cfg: MoEConfig) -> dict:
+    """Backbone (embed/attention/norms — no dense FFN) + MoE FFN params."""
+    from .llama import init_params
+
+    k1, k2 = jax.random.split(key)
+    dense = init_params(k1, cfg)
+    for w in ("w_gate", "w_up", "w_down"):   # replaced by experts
+        del dense["blocks"][w]
+    return {"backbone": dense, "moe": init_moe_params(k2, cfg)}
+
+
+def moe_model_specs(cfg: MoEConfig) -> dict:
+    from .llama import param_specs
+
+    dense = param_specs(cfg)
+    for w in ("w_gate", "w_up", "w_down"):
+        del dense["blocks"][w]
+    return {"backbone": dense, "moe": moe_param_specs()}
+
+
+def moe_forward(params: dict, tokens, cfg: MoEConfig, attn_fn=None):
+    """Logits + mean aux losses. tokens: [B, S] → ([B, S, V], aux dict)."""
+    from .llama import _rope  # noqa: F401  (rope applied inside the block)
+    from ..parallel.ring import dense_attention
+
+    if attn_fn is None:
+        attn_fn = dense_attention
+    ad = cfg.act_dtype
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)
+    backbone = params["backbone"]
+    x = backbone["embed"].astype(ad)[tokens]
+
+    blk = partial(moe_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
+    if cfg.remat:
+        blk = jax.checkpoint(blk)
+
+    def scan_body(x, layer):
+        lp_dense, lp_moe = layer
+        x, aux = blk(x, lp_dense, lp_moe)
+        return x, aux
+
+    x, aux_stacked = lax.scan(scan_body, x,
+                              (backbone["blocks"], params["moe"]))
+    aux = jax.tree.map(jnp.mean, aux_stacked)
+
+    x = _rmsnorm(x, backbone["ln_final"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ backbone["lm_head"].astype(jnp.float32)
+    return logits, aux
+
+
+def moe_loss_fn(params, inputs, targets, cfg: MoEConfig, attn_fn=None,
+                lb_coeff: float = 1e-2):
+    logits, aux = moe_forward(params, inputs, cfg, attn_fn=attn_fn)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = jnp.mean(logz - gold)
+    return (ce + lb_coeff * aux["load_balance"]
+            + cfg.router_z_loss * aux["router_z"])
+
+
+def make_moe_train_step(mesh, cfg: MoEConfig, optimizer=None):
+    """jitted MoE train step over the (slice, data, seq, expert, model) mesh."""
+    import optax
+
+    from .train import default_optimizer, make_attn_fn
+
+    if optimizer is None:
+        optimizer = default_optimizer()
+    attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl)
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = jax.value_and_grad(moe_loss_fn)(
+            params, inputs, targets, cfg, attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_moe_train_state(key, cfg: MoEConfig, mesh, optimizer=None):
+    from jax.sharding import NamedSharding
+
+    from .train import default_optimizer
+
+    if optimizer is None:
+        optimizer = default_optimizer()
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        init_moe_model(key, cfg), moe_model_specs(cfg))
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state, optimizer

@@ -104,3 +104,59 @@ def make_forward(cfg: LlamaConfig):
         return forward(params, tokens, cfg)
 
     return jax.jit(fn)
+
+
+def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
+                             optimizer=None):
+    """Train step with the decoder blocks pipelined over ``pipe``
+    (parallel/pipeline.py): embed/head replicated, blocks layer-sharded,
+    microbatches streamed gpipe-style. Composes with (slice, data) batch
+    sharding; attention is dense within a stage (sp must be 1)."""
+    from functools import partial as _partial
+
+    from ..parallel.pipeline import pipelined_blocks
+    from ..parallel.topology import AXIS_PIPE
+    from .llama import _block, _rmsnorm
+
+    if mesh.shape[AXIS_SEQ] != 1:
+        raise ValueError("pipeline parallelism composes with dp/slice, "
+                         "not sp — build the mesh with sp=1")
+    if optimizer is None:
+        optimizer = default_optimizer()
+
+    def pipelined_forward(params, tokens):
+        ad = cfg.act_dtype
+        B, S = tokens.shape
+        positions = jnp.arange(S, dtype=jnp.int32)
+        x = params["embed"].astype(ad)[tokens]
+        block_fn = lambda lp, h: _block(h, lp, cfg, positions,
+                                        dense_attention)
+        apply = pipelined_blocks(block_fn, mesh, cfg.n_layers, n_micro)
+        x = apply(params["blocks"], x)
+        x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
+        return x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+
+    def loss(params, inputs, targets):
+        logits = pipelined_forward(params, inputs)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    def step(params, opt_state, inputs, targets):
+        l, grads = jax.value_and_grad(loss)(params, inputs, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, l
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def pipeline_param_specs(cfg: LlamaConfig) -> dict:
+    """Pipeline layout: blocks layer-sharded over ``pipe``, everything else
+    replicated (tp-within-pp is a future refinement)."""
+    from ..parallel.topology import AXIS_PIPE
+
+    specs = param_specs(cfg)
+    specs = jax.tree.map(lambda _: P(), specs)
+    specs["blocks"] = jax.tree.map(lambda _: P(AXIS_PIPE), specs["blocks"])
+    return specs

@@ -160,6 +160,16 @@ async def run_real(opts) -> int:
         node_repair=opts.feature_gates.node_repair)
     manager = Manager(kube).register(*controllers)
 
+    stop = asyncio.Event()
+    elector = None
+    if not opts.disable_leader_election:  # default OFF (options.go:117)
+        from ..runtime.leaderelection import LeaderElector
+        elector = LeaderElector(kube, namespace=conn.namespace,
+                                on_lost=stop.set)
+        log.info("waiting for leadership",
+                 extra={"identity": elector.identity})
+        await elector.run_until_leading()
+
     eviction.start()
     await manager.start()
     runners = await start_servers(manager, opts.metrics_port,
@@ -168,7 +178,6 @@ async def run_real(opts) -> int:
     log.info("operator up", extra={"project": cfg.project_id,
                                    "location": cfg.location,
                                    "cluster": cfg.cluster_name})
-    stop = asyncio.Event()
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
@@ -180,6 +189,8 @@ async def run_real(opts) -> int:
     finally:
         await manager.stop()
         await eviction.stop()
+        if elector is not None:
+            await elector.stop()
         for r in runners:
             await r.cleanup()
         await kube.aclose()

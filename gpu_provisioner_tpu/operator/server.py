@@ -13,8 +13,12 @@ from __future__ import annotations
 import asyncio
 import traceback
 
+import platform
+
 from aiohttp import web
-from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
+from prometheus_client import Gauge, REGISTRY, generate_latest, CONTENT_TYPE_LATEST
+
+from .. import __version__
 
 from ..apis.karpenter import NodeClaim
 from ..apis.meta import _KINDS
@@ -22,6 +26,22 @@ from ..apis.meta import _KINDS
 # metric families so /metrics always exposes them, whatever the import order
 from ..cloudprovider import metrics as _cloudprovider_metrics  # noqa: F401
 from ..runtime.controller import Manager
+
+
+# Build-info gauge (operator.go:69-92's karpenter_build_info analog):
+# constant 1, stamped with version identifiers for dashboards/alerts.
+def _build_info() -> Gauge:
+    name = "tpu_provisioner_build_info"
+    if name in REGISTRY._names_to_collectors:  # test re-imports
+        return REGISTRY._names_to_collectors[name]
+    g = Gauge(name, "Build/runtime identifiers (constant 1).",
+              ["version", "python_version"])
+    g.labels(version=__version__,
+             python_version=platform.python_version()).set(1)
+    return g
+
+
+BUILD_INFO = _build_info()
 
 
 def build_apps(manager: Manager, enable_profiling: bool = False):

@@ -7,9 +7,11 @@ is the workload half of that contract — it turns those labels into a
 the sequence-parallel ring attention used by the flagship model.
 """
 
-from .topology import (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_SLICE,
-                       SliceTopology, make_mesh, mesh_shape_for)
+from .topology import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
+                       AXIS_SEQ, AXIS_SLICE, SliceTopology, make_mesh,
+                       mesh_shape_for)
 from .ring import ring_attention
 
 __all__ = ["SliceTopology", "make_mesh", "mesh_shape_for", "ring_attention",
-           "AXIS_SLICE", "AXIS_DATA", "AXIS_SEQ", "AXIS_MODEL"]
+           "AXIS_SLICE", "AXIS_DATA", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
+           "AXIS_MODEL"]

@@ -9,12 +9,14 @@ projects them into TPU pods, and this module consumes them to bootstrap
 Axis convention (scaling-book ordering — slowest-varying interconnect
 outermost):
 
-    (slice, data, seq, model)
+    (slice, data, pipe, seq, expert, model)
 
 ``slice`` spans slices over DCN (multi-slice data parallelism — the
-"N NodeClaims → N slices" configuration in BASELINE.json); ``data``/``seq``/
-``model`` ride ICI within one slice. Batch is sharded over (slice, data),
-sequence over ``seq`` (ring attention), and parameters over ``model``.
+"N NodeClaims → N slices" configuration in BASELINE.json); the rest ride
+ICI within one slice. Batch is sharded over (slice, data), pipeline
+stages over ``pipe`` (layer-sharded gpipe, parallel/pipeline.py), sequence
+over ``seq`` (ring attention), MoE experts over ``expert`` (all-to-all
+dispatch), and dense parameters over ``model`` (tensor parallelism).
 """
 
 from __future__ import annotations
@@ -28,9 +30,12 @@ from ..apis import labels as wk
 
 AXIS_SLICE = "slice"
 AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
 AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
 AXIS_MODEL = "model"
-MESH_AXES = (AXIS_SLICE, AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+MESH_AXES = (AXIS_SLICE, AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT,
+             AXIS_MODEL)
 
 # GKE injects these into TPU pods (the downward-API half of the contract;
 # TPU_WORKER_HOSTNAMES is the same variable the Cloud TPU runtime uses).
@@ -152,11 +157,12 @@ class SliceTopology:
 
 
 def mesh_shape_for(n_devices: int, *, num_slices: int = 1,
-                   sp: int = 1, tp: int = 1,
-                   dp: Optional[int] = None) -> tuple[int, int, int, int]:
-    """Factor ``n_devices`` into the (slice, data, seq, model) mesh shape.
+                   sp: int = 1, tp: int = 1, ep: int = 1, pp: int = 1,
+                   dp: Optional[int] = None
+                   ) -> tuple[int, int, int, int, int, int]:
+    """Factor ``n_devices`` into (slice, data, pipe, seq, expert, model).
 
-    ``dp`` defaults to whatever is left after slice/seq/model are taken.
+    ``dp`` defaults to whatever is left after the other axes are taken.
     Raises TopologyError on non-divisibility so a bad deployment config
     fails at mesh build, not as a cryptic XLA reshape error.
     """
@@ -164,22 +170,23 @@ def mesh_shape_for(n_devices: int, *, num_slices: int = 1,
         raise TopologyError(f"{n_devices} devices not divisible by "
                             f"num_slices={num_slices}")
     per_slice = n_devices // num_slices
-    if per_slice % (sp * tp):
+    if per_slice % (sp * tp * ep * pp):
         raise TopologyError(f"{per_slice} devices/slice not divisible by "
-                            f"sp*tp={sp}*{tp}")
-    inferred = per_slice // (sp * tp)
+                            f"sp*tp*ep*pp={sp}*{tp}*{ep}*{pp}")
+    inferred = per_slice // (sp * tp * ep * pp)
     if dp is None:
         dp = inferred
     elif dp != inferred:
         raise TopologyError(f"dp={dp} inconsistent: {num_slices}sl×{dp}dp×"
-                            f"{sp}sp×{tp}tp != {n_devices} devices")
-    return (num_slices, dp, sp, tp)
+                            f"{pp}pp×{sp}sp×{ep}ep×{tp}tp != {n_devices}")
+    return (num_slices, dp, pp, sp, ep, tp)
 
 
 def make_mesh(n_devices: Optional[int] = None, *, num_slices: int = 1,
-              sp: int = 1, tp: int = 1, dp: Optional[int] = None,
+              sp: int = 1, tp: int = 1, ep: int = 1, pp: int = 1,
+              dp: Optional[int] = None,
               devices: Optional[Sequence] = None):
-    """Build the (slice, data, seq, model) ``jax.sharding.Mesh``.
+    """Build the (slice, data, pipe, seq, expert, model) ``jax.sharding.Mesh``.
 
     Uses ``mesh_utils.create_device_mesh`` for ICI-aware device ordering on
     real TPU topologies, falling back to a plain reshape (CPU meshes, odd
@@ -196,7 +203,8 @@ def make_mesh(n_devices: Optional[int] = None, *, num_slices: int = 1,
     if n_devices is None:
         n_devices = len(devices)
     devices = list(devices)[:n_devices]
-    shape = mesh_shape_for(n_devices, num_slices=num_slices, sp=sp, tp=tp, dp=dp)
+    shape = mesh_shape_for(n_devices, num_slices=num_slices, sp=sp, tp=tp,
+                           ep=ep, pp=pp, dp=dp)
     try:
         dev_array = mesh_utils.create_device_mesh(
             shape, devices=np.asarray(devices))

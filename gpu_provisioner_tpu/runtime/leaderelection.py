@@ -1,0 +1,161 @@
+"""Lease-based leader election (vendor/.../operator/operator.go:157-164).
+
+The reference delegates to client-go's leaderelection via controller-runtime:
+acquire a coordination.k8s.io Lease, renew it at ``renew_interval``, and if
+another holder's lease has expired, take it over (bumping
+``lease_transitions``). Losing the lease is fatal — the reference's manager
+exits so the replica restarts into candidacy; ``on_lost`` defaults to
+setting an event the operator treats as a stop signal.
+
+Defaults mirror client-go: 15s lease, 10s renew deadline, 2s retry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import os
+import socket
+import uuid
+from typing import Callable, Optional
+
+from ..apis.core import Lease, LeaseSpec
+from ..apis.meta import ObjectMeta
+from ..apis.serde import now
+from .client import Client, ConflictError, NotFoundError, AlreadyExistsError
+
+log = logging.getLogger("leaderelection")
+
+LEASE_DURATION = 15.0
+RENEW_INTERVAL = 10.0
+RETRY_INTERVAL = 2.0
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    def __init__(self, client: Client, lease_name: str = "tpu-provisioner",
+                 namespace: str = "default",
+                 identity: Optional[str] = None,
+                 lease_duration: float = LEASE_DURATION,
+                 renew_interval: float = RENEW_INTERVAL,
+                 retry_interval: float = RETRY_INTERVAL,
+                 on_lost: Optional[Callable[[], None]] = None):
+        self.client = client
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.retry_interval = retry_interval
+        self.on_lost = on_lost
+        self.leading = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def run_until_leading(self) -> None:
+        """Block until this replica holds the lease, then keep renewing in
+        the background."""
+        while not await self._try_acquire():
+            await asyncio.sleep(self.retry_interval)
+        self.leading.set()
+        log.info("leader election won", extra={"identity": self.identity,
+                                               "lease": self.lease_name})
+        self._task = asyncio.create_task(self._renew_loop(),
+                                         name="lease-renew")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self._release()
+        self.leading.clear()
+
+    # --- internals ---------------------------------------------------------
+
+    def _expired(self, lease: Lease) -> bool:
+        if lease.spec.renew_time is None:
+            return True
+        age = (now() - lease.spec.renew_time).total_seconds()
+        return age > lease.spec.lease_duration_seconds
+
+    async def _try_acquire(self) -> bool:
+        try:
+            lease = await self.client.get(Lease, self.lease_name, self.namespace)
+        except NotFoundError:
+            fresh = Lease(
+                metadata=ObjectMeta(name=self.lease_name,
+                                    namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    # Lease times are metav1.Time (second resolution) — a
+                    # sub-second duration must round UP or it is born expired
+                    lease_duration_seconds=max(1, math.ceil(self.lease_duration)),
+                    acquire_time=now(), renew_time=now()))
+            try:
+                await self.client.create(fresh)
+                return True
+            except AlreadyExistsError:
+                return False
+        if lease.spec.holder_identity == self.identity:
+            return await self._renew(lease)
+        if not self._expired(lease):
+            return False
+        # expired foreign lease → steal
+        lease.spec.holder_identity = self.identity
+        lease.spec.acquire_time = now()
+        lease.spec.renew_time = now()
+        lease.spec.lease_transitions += 1
+        try:
+            await self.client.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False  # someone else won the race
+
+    async def _renew(self, lease: Optional[Lease] = None) -> bool:
+        try:
+            if lease is None:
+                lease = await self.client.get(Lease, self.lease_name,
+                                              self.namespace)
+            if lease.spec.holder_identity != self.identity:
+                return False
+            lease.spec.renew_time = now()
+            await self.client.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    async def _renew_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.renew_interval)
+            deadline = asyncio.get_event_loop().time() + self.lease_duration
+            renewed = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await self._renew():
+                    renewed = True
+                    break
+                await asyncio.sleep(self.retry_interval)
+            if not renewed:
+                log.error("leadership lost", extra={"identity": self.identity})
+                self.leading.clear()
+                if self.on_lost is not None:
+                    self.on_lost()
+                return
+
+    async def _release(self) -> None:
+        """Voluntary release on clean shutdown so the next replica doesn't
+        wait out the lease."""
+        try:
+            lease = await self.client.get(Lease, self.lease_name, self.namespace)
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = None
+                await self.client.update(lease)
+        except (NotFoundError, ConflictError):
+            pass

@@ -32,7 +32,7 @@ def _cls_for(plural: str) -> type:
     return kind_for({
         "nodeclaims": "NodeClaim", "nodes": "Node", "pods": "Pod",
         "volumeattachments": "VolumeAttachment", "events": "Event",
-        "kaitonodeclasses": "KaitoNodeClass",
+        "kaitonodeclasses": "KaitoNodeClass", "leases": "Lease",
     }[plural])
 
 

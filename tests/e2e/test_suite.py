@@ -181,6 +181,23 @@ async def test_node_repair_replaces_unhealthy(tmp_path):
 
 
 @async_test
+async def test_operator_with_leader_election(tmp_path):
+    """Multi-replica readiness: election ON (reference defaults it off,
+    options.go:117, but implements it) — the operator must acquire the
+    coordination.k8s.io Lease before reconciling, then work normally."""
+    from gpu_provisioner_tpu.apis.core import Lease
+    async with Environment(
+            tmp_path,
+            extra_env={"DISABLE_LEADER_ELECTION": "false"}) as env:
+        lease = await env.eventually(
+            lambda: env.client.get(Lease, "tpu-provisioner", "default"),
+            what="lease acquired")
+        assert lease.spec.holder_identity
+        await env.client.create(make_nodeclaim("led0", "tpu-v5e-8"))
+        await env.expect_nodeclaim_ready("led0")
+
+
+@async_test
 async def test_multislice_group_provisions_n_slices(tmp_path):
     """BASELINE config 5: 4× v5e-16 NodeClaims in one DCN slice group."""
     async with Environment(tmp_path) as env:

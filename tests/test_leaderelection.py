@@ -1,0 +1,81 @@
+"""Lease leader-election tests (reference: operator.go:157-164 via client-go)."""
+
+import asyncio
+
+from gpu_provisioner_tpu.apis.core import Lease
+from gpu_provisioner_tpu.runtime import InMemoryClient
+from gpu_provisioner_tpu.runtime.leaderelection import LeaderElector
+
+from .conftest import async_test
+
+# second-resolution Lease timestamps (metav1.Time) bound how fast these run
+FAST = dict(lease_duration=2.0, renew_interval=0.4, retry_interval=0.1)
+
+
+@async_test
+async def test_single_elector_acquires_and_renews():
+    client = InMemoryClient()
+    el = LeaderElector(client, identity="a", **FAST)
+    await el.run_until_leading()
+    assert el.leading.is_set()
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.holder_identity == "a"
+    first_renew = lease.spec.renew_time
+    await asyncio.sleep(1.5)
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.renew_time > first_renew  # renew loop is live
+    await el.stop()
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.holder_identity == ""  # voluntary release
+
+
+@async_test
+async def test_second_elector_waits_then_takes_over():
+    client = InMemoryClient()
+    a = LeaderElector(client, identity="a", **FAST)
+    b = LeaderElector(client, identity="b", **FAST)
+    await a.run_until_leading()
+
+    b_task = asyncio.create_task(b.run_until_leading())
+    await asyncio.sleep(0.5)
+    assert not b.leading.is_set()  # blocked while a holds the lease
+
+    await a.stop()                 # release → b should win promptly
+    await asyncio.wait_for(b_task, 5)
+    assert b.leading.is_set()
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.holder_identity == "b"
+    assert lease.spec.lease_transitions >= 0
+    await b.stop()
+
+
+@async_test
+async def test_expired_lease_is_stolen():
+    client = InMemoryClient()
+    a = LeaderElector(client, identity="a", **FAST)
+    await a.run_until_leading()
+    # a dies without releasing (crash): cancel renewals only
+    a._task.cancel()
+    b = LeaderElector(client, identity="b", **FAST)
+    t0 = asyncio.get_event_loop().time()
+    await asyncio.wait_for(b.run_until_leading(), 10)
+    waited = asyncio.get_event_loop().time() - t0
+    assert waited >= 1.0  # had to wait out most of the 2s lease
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.holder_identity == "b"
+    assert lease.spec.lease_transitions == 1
+    await b.stop()
+
+
+@async_test
+async def test_lost_leadership_fires_callback():
+    client = InMemoryClient()
+    lost = asyncio.Event()
+    a = LeaderElector(client, identity="a", on_lost=lost.set, **FAST)
+    await a.run_until_leading()
+    # usurper rewrites the lease out from under a
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    lease.spec.holder_identity = "usurper"
+    await client.update(lease)
+    await asyncio.wait_for(lost.wait(), 10)
+    assert not a.leading.is_set()
