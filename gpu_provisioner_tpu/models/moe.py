@@ -229,14 +229,11 @@ def make_moe_train_step(mesh, cfg: MoEConfig, optimizer=None):
 
 
 def make_moe_train_state(key, cfg: MoEConfig, mesh, optimizer=None):
-    from jax.sharding import NamedSharding
-
-    from .train import default_optimizer
+    from .train import default_optimizer, shard_params
 
     if optimizer is None:
         optimizer = default_optimizer()
-    params = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        init_moe_model(key, cfg), moe_model_specs(cfg))
+    params = shard_params(init_moe_model(key, cfg), mesh,
+                          specs=moe_model_specs(cfg))
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state, optimizer

@@ -61,9 +61,11 @@ def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None):
     return jnp.mean(logz - gold)
 
 
-def shard_params(params, mesh, cfg: LlamaConfig):
-    """Place a parameter pytree onto the mesh per param_specs."""
-    specs = param_specs(cfg)
+def shard_params(params, mesh, cfg: Optional[LlamaConfig] = None, specs=None):
+    """Place a parameter pytree onto the mesh, per ``specs`` when given,
+    else per param_specs(cfg)."""
+    if specs is None:
+        specs = param_specs(cfg)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 
@@ -160,3 +162,13 @@ def pipeline_param_specs(cfg: LlamaConfig) -> dict:
     specs = jax.tree.map(lambda _: P(), specs)
     specs["blocks"] = jax.tree.map(lambda _: P(AXIS_PIPE), specs["blocks"])
     return specs
+
+
+def make_pipeline_train_state(key, cfg: LlamaConfig, mesh, optimizer=None):
+    """(params, opt_state, optimizer) laid out per pipeline_param_specs."""
+    if optimizer is None:
+        optimizer = default_optimizer()
+    params = shard_params(init_params(key, cfg), mesh,
+                          specs=pipeline_param_specs(cfg))
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state, optimizer

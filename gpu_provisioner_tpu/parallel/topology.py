@@ -156,6 +156,24 @@ class SliceTopology:
         return cls.from_node_labels(labels, environ=env, num_slices=num_slices)
 
 
+def drop_foreign_backend_factories() -> None:
+    """Deregister non-builtin JAX backend factories before first backend init.
+
+    Site hooks (e.g. axon register) can wrap ``get_backend`` so the first
+    ``jax.devices(...)`` call — even ``jax.devices("cpu")`` — initializes
+    EVERY registered plugin; a wedged/broken accelerator client then hangs
+    the process rather than raising. Builtin factories ("tpu", "cuda", ...)
+    stay: they are part of MLIR's known-platform set and fail fast when the
+    hardware is absent."""
+    try:
+        from jax._src import xla_bridge as xb
+        for plat in [p for p in xb._backend_factories
+                     if p not in ("cpu", "tpu", "cuda", "rocm", "gpu")]:
+            xb._backend_factories.pop(plat, None)
+    except Exception:
+        pass  # private API moved — callers fall back to probing
+
+
 def mesh_shape_for(n_devices: int, *, num_slices: int = 1,
                    sp: int = 1, tp: int = 1, ep: int = 1, pp: int = 1,
                    dp: Optional[int] = None

@@ -18,9 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Site hooks (axon register) may override jax_platforms at interpreter start,
 # which silently ignores the env var above — force the config directly.
+# The axon wrapper also initializes EVERY registered backend on first
+# jax.devices() call even under jax_platforms=cpu, so a wedged TPU tunnel
+# would hang the whole suite — drop the non-CPU factories outright; these
+# tests only ever use the forced-host CPU mesh.
 try:
     import jax as _jax
     _jax.config.update("jax_platforms", "cpu")
+    from gpu_provisioner_tpu.parallel.topology import (
+        drop_foreign_backend_factories as _drop)
+    _drop()
 except ImportError:
     pass
 

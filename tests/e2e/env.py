@@ -81,6 +81,12 @@ class Environment:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env = {**os.environ,
+               # The operator is control-plane only — never imports jax.
+               # Site hooks (axon sitecustomize) preload jax + a PJRT
+               # plugin into every interpreter when this var is set, which
+               # added seconds of startup and caused readiness-timeout
+               # flakes when specs shared the box with JAX-compiling tests.
+               "PALLAS_AXON_POOL_IPS": "",
                "PYTHONPATH": repo_root + os.pathsep
                + os.environ.get("PYTHONPATH", ""),
                "KUBECONFIG": str(kubeconfig),
@@ -117,7 +123,7 @@ class Environment:
 
     async def _await_ready(self) -> None:
         async with httpx.AsyncClient() as http:
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + 180
             while time.monotonic() < deadline:
                 if self.proc.returncode is not None:
                     self.dump_logs()

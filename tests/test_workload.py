@@ -80,9 +80,11 @@ def test_topology_bad_label_value_is_topology_error():
 
 
 def test_mesh_shape_factoring():
-    assert mesh_shape_for(8, sp=2, tp=2) == (1, 2, 2, 1, 2)
-    assert mesh_shape_for(16, num_slices=2, tp=4) == (2, 2, 1, 1, 4)
-    assert mesh_shape_for(8, ep=4, tp=2) == (1, 1, 1, 4, 2)
+    # (slice, data, pipe, seq, expert, model)
+    assert mesh_shape_for(8, sp=2, tp=2) == (1, 2, 1, 2, 1, 2)
+    assert mesh_shape_for(16, num_slices=2, tp=4) == (2, 2, 1, 1, 1, 4)
+    assert mesh_shape_for(8, ep=4, tp=2) == (1, 1, 1, 1, 4, 2)
+    assert mesh_shape_for(8, pp=2, tp=2) == (1, 2, 2, 1, 1, 2)
     with pytest.raises(TopologyError, match="not divisible"):
         mesh_shape_for(8, sp=3)
     with pytest.raises(TopologyError, match="inconsistent"):
@@ -92,7 +94,7 @@ def test_mesh_shape_factoring():
 def test_make_mesh_axes():
     mesh = make_mesh(8, sp=2, tp=2)
     assert mesh.axis_names == MESH_AXES
-    assert dict(mesh.shape) == {"slice": 1, "data": 2, "seq": 2,
+    assert dict(mesh.shape) == {"slice": 1, "data": 2, "pipe": 1, "seq": 2,
                                 "expert": 1, "model": 2}
 
 
