@@ -56,6 +56,13 @@ TPU_HOSTS_LABEL = "tpu.kaito.sh/hosts"                 # VM count in slice
 TPU_SLICE_ID_LABEL = "tpu.kaito.sh/slice-id"           # node-pool name
 TPU_WORKER_INDEX_LABEL = "tpu.kaito.sh/worker-index"   # 0..hosts-1, per node
 TPU_SLICE_GROUP_LABEL = "tpu.kaito.sh/slice-group"     # multi-slice DCN group
+# Multi-slice identity, stamped by the instance provider at create so every
+# member of a slice-group can bootstrap jax.distributed with NO manual env
+# (the analog of the reference stamping labels at create, instance.go:321-369,
+# synced to nodes by registration.go:120-147):
+TPU_SLICE_INDEX_LABEL = "tpu.kaito.sh/slice-index"     # 0..num_slices-1
+TPU_NUM_SLICES_LABEL = "tpu.kaito.sh/num-slices"       # group size
+TPU_COORDINATOR_LABEL = "tpu.kaito.sh/coordinator"     # worker 0 of slice 0
 
 # Taint applied by GKE to TPU nodes; tolerated by TPU workloads.
 TPU_TAINT = "google.com/tpu"

@@ -21,6 +21,7 @@ from ..runtime.events import Recorder
 from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
+from .slicegroup import SliceGroupController, group_requests
 from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
 
 
@@ -38,6 +39,7 @@ def build_controllers(client: Client, cloudprovider,
                       health_options: Optional[HealthOptions] = None,
                       node_repair: bool = True,
                       max_concurrent_reconciles: int = 64,
+                      cluster: str = "kaito",
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -62,6 +64,11 @@ def build_controllers(client: Client, cloudprovider,
                    max_concurrent=1).as_singleton(),
         Controller(nodeclaim_gc.NAME, Singleton(nodeclaim_gc.run_once),
                    max_concurrent=1).as_singleton(),
+        Controller(SliceGroupController.NAME,
+                   SliceGroupController(client, cluster=cluster),
+                   max_concurrent=4)
+        .watches(Node, map_fn=group_requests)
+        .watches(NodeClaim, map_fn=group_requests),
     ]
     # Node health only with repair policies + gate (controllers.go:110-113).
     if node_repair and cloudprovider.repair_policies():

@@ -1,0 +1,101 @@
+"""Slice-group identity controller: converge multi-slice labels on nodes.
+
+The instance provider stamps per-pool identity at create
+(providers/instance.py:_slice_group_identity): slice-index is sticky and
+never rewritten here, but the *group-wide* facts — num-slices and the
+coordinator (worker 0 of slice 0) — change as membership changes: a member
+joining an existing group, or the slice-0 pool being deleted and replaced
+under a new claim name. Pool labels are only applied to nodes at join, so
+this controller re-stamps the *Node* labels (what workloads consume via
+``SliceTopology.from_node_labels``) whenever the group drifts.
+
+Reconcile key = the slice-group name; Node/NodeClaim watch events map to
+their group. Extends the reference's create-time label seam
+(/root/reference/pkg/providers/instance/instance.go:321-369) with the
+continuous label sync of
+vendor/sigs.k8s.io/karpenter/pkg/controllers/nodeclaim/lifecycle/registration.go:120-147,
+applied at group scope.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..apis.karpenter import NodeClaim
+from ..runtime import Request, Result
+from ..runtime.client import Client, patch_retry
+
+log = logging.getLogger("controllers.slicegroup")
+
+
+def group_requests(obj) -> list[Request]:
+    group = obj.metadata.labels.get(wk.TPU_SLICE_GROUP_LABEL, "")
+    return [Request(name=group)] if group else []
+
+
+class SliceGroupController:
+    NAME = "slicegroup.identity"
+
+    def __init__(self, client: Client, cluster: str = "kaito",
+                 resync_seconds: float = 60.0):
+        self.client = client
+        self.cluster = cluster
+        self.resync = resync_seconds
+
+    async def reconcile(self, req: Request) -> Result:
+        group = req.name
+        nodes = await self.client.list(
+            Node, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+        if not nodes:
+            return Result()
+
+        # sticky per-pool indices, read back from the nodes themselves
+        pool_index: dict[str, int] = {}
+        for n in nodes:
+            pool = (n.metadata.labels.get(wk.TPU_SLICE_ID_LABEL)
+                    or n.metadata.labels.get(wk.GKE_NODEPOOL_LABEL, ""))
+            idx = n.metadata.labels.get(wk.TPU_SLICE_INDEX_LABEL, "")
+            if pool and idx.isdigit():
+                pool_index[pool] = int(idx)
+        if not pool_index:
+            return Result()
+
+        claims = await self.client.list(
+            NodeClaim, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+        declared = 0
+        for c in claims:
+            d = c.metadata.labels.get(wk.TPU_NUM_SLICES_LABEL, "")
+            if d.isdigit():
+                declared = max(declared, int(d))
+        num_slices = declared or max(len(pool_index), len(claims),
+                                     max(pool_index.values()) + 1)
+
+        desired = {wk.TPU_NUM_SLICES_LABEL: str(num_slices)}
+        owner0 = next((p for p, i in pool_index.items() if i == 0), None)
+        if owner0 is not None:
+            # GKE instance naming convention — worker 0 of the slice-0 pool
+            # (providers/instance.py:instance_name)
+            desired[wk.TPU_COORDINATOR_LABEL] = \
+                f"gke-{self.cluster}-{owner0}-w0"
+
+        for n in nodes:
+            if all(n.metadata.labels.get(k) == v for k, v in desired.items()):
+                continue
+
+            def mutate(obj, _desired=desired):
+                if all(obj.metadata.labels.get(k) == v
+                       for k, v in _desired.items()):
+                    return False
+                obj.metadata.labels.update(_desired)
+                return True
+
+            await patch_retry(self.client, Node, n.metadata.name, mutate)
+            log.info("slice-group %s: synced identity labels onto node %s "
+                     "(%s)", group, n.metadata.name, desired)
+
+        # periodic resync guards against missed watch events (group members
+        # appear via pool joins the Node watch does see, but cheap insurance)
+        return Result(requeue_after=self.resync)

@@ -104,7 +104,7 @@ async def run_real(opts) -> int:
     from ..controllers.gc import GCOptions
     from ..controllers.lifecycle import LifecycleOptions
     from ..controllers.registry import build_controllers
-    from ..providers.instance import InstanceProvider
+    from ..providers.instance import InstanceProvider, ProviderConfig
     from ..providers.rest import CloudTPUQueuedResourcesClient, GKENodePoolsClient
     from ..runtime import Manager
     from ..runtime.events import Recorder
@@ -138,7 +138,11 @@ async def run_real(opts) -> int:
     queued = CloudTPUQueuedResourcesClient(
         cred, cfg.project_id, cfg.location,
         endpoint=cfg.tpu_api_endpoint or gcprest.TPU_ENDPOINT)
-    provider = InstanceProvider(nodepools, kube, queued=queued)
+    provider = InstanceProvider(
+        nodepools, kube,
+        ProviderConfig(project=cfg.project_id, zone=cfg.location,
+                       cluster=cfg.cluster_name),
+        queued=queued)
     cloudprovider = MetricsDecorator(TPUCloudProvider(
         provider, repair_toleration=opts.repair_toleration_seconds))
 
@@ -157,7 +161,8 @@ async def run_real(opts) -> int:
         gc_options=GCOptions(interval=opts.gc_interval_seconds,
                              leak_grace=opts.gc_leak_grace_seconds),
         max_concurrent_reconciles=opts.max_concurrent_reconciles,
-        node_repair=opts.feature_gates.node_repair)
+        node_repair=opts.feature_gates.node_repair,
+        cluster=cfg.cluster_name)
     manager = Manager(kube).register(*controllers)
 
     stop = asyncio.Event()

@@ -1,0 +1,89 @@
+"""In-cluster workload bootstrap: node labels → SliceTopology → jax.distributed.
+
+The last hop of the provisioner contract. The instance provider stamps
+``tpu.kaito.sh/*`` (incl. multi-slice slice-index / num-slices / coordinator,
+providers/instance.py:_slice_group_identity) onto node pools; GKE copies pool
+labels onto Nodes. A workload pod cannot project *node* labels via the
+downward API — only its own fields — so the supported contract is:
+
+1. the pod projects ``spec.nodeName`` into ``NODE_NAME`` (downward API,
+   see examples/jobset-multislice.yaml),
+2. this module GETs that Node with the pod's in-cluster service account
+   (RBAC: get on nodes) and reads the labels,
+3. ``SliceTopology.from_node_labels`` + ``distributed_init_args`` feed
+   ``jax.distributed.initialize`` — no manual env required.
+
+Generalizes the reference seam where labels stamped at create
+(/root/reference/pkg/providers/instance/instance.go:321-369) are synced to
+nodes for workloads to consume
+(vendor/sigs.k8s.io/karpenter/pkg/controllers/nodeclaim/lifecycle/registration.go:120-147).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+from .topology import SliceTopology, TopologyError
+
+ENV_NODE_NAME = "NODE_NAME"
+
+
+async def node_labels_from_api(node_name: str,
+                               connection=None) -> dict[str, str]:
+    """GET the Node and return its labels using the in-cluster credentials
+    (or an explicit runtime ``KubeConnection``)."""
+    from ..apis.core import Node
+    from ..runtime.rest import KubeConnection, RestClient
+
+    conn = connection or KubeConnection.in_cluster()
+    client = RestClient(conn)
+    try:
+        node = await client.get(Node, node_name)
+    finally:
+        aclose = getattr(client, "aclose", None)
+        if aclose:
+            await aclose()
+    return dict(node.metadata.labels)
+
+
+def topology_from_labels(labels: Mapping[str, str],
+                         environ: Optional[Mapping[str, str]] = None
+                         ) -> SliceTopology:
+    return SliceTopology.from_node_labels(labels, environ=environ)
+
+
+async def discover(environ: Optional[Mapping[str, str]] = None,
+                   connection=None) -> SliceTopology:
+    """SliceTopology for THIS pod: node labels via the API when NODE_NAME is
+    projected, else pure-env fallback (TPU_KAITO_* downward/static vars)."""
+    env = environ if environ is not None else os.environ
+    node_name = env.get(ENV_NODE_NAME, "")
+    if node_name:
+        labels = await node_labels_from_api(node_name, connection=connection)
+        return SliceTopology.from_node_labels(labels, environ=env)
+    return SliceTopology.from_env(env)
+
+
+def initialize_distributed(topo: SliceTopology) -> None:
+    """Call ``jax.distributed.initialize`` from a discovered topology.
+
+    Idempotent-ish: skips when a distributed client is already live (e.g.
+    the runtime initialized it) and when the topology is a single-process
+    slice (1 host, 1 slice) where initialization is unnecessary."""
+    if topo.hosts * topo.num_slices <= 1:
+        return
+    import jax
+
+    state = getattr(getattr(jax, "_src", None), "distributed", None)
+    if state is not None and getattr(state.global_state, "client", None):
+        return
+    jax.distributed.initialize(**topo.distributed_init_args())
+
+
+async def bootstrap(environ: Optional[Mapping[str, str]] = None,
+                    connection=None) -> SliceTopology:
+    """discover() + initialize_distributed(): the one-call pod entrypoint."""
+    topo = await discover(environ=environ, connection=connection)
+    initialize_distributed(topo)
+    return topo

@@ -108,11 +108,14 @@ class SliceTopology:
     @classmethod
     def from_node_labels(cls, labels: Mapping[str, str],
                          environ: Optional[Mapping[str, str]] = None,
-                         num_slices: int = 1) -> "SliceTopology":
+                         num_slices: Optional[int] = None) -> "SliceTopology":
         """Build from the ``tpu.kaito.sh/*`` labels the provisioner stamped.
 
-        ``environ`` supplies the per-worker identity (worker id/hostnames)
-        that labels cannot carry pod-portably.
+        Multi-slice identity (slice-index / num-slices / coordinator) is
+        read from the labels the instance provider stamps at create
+        (providers/instance.py:_slice_group_identity) — env vars are only a
+        fallback/override. ``environ`` additionally supplies the per-worker
+        identity (worker id/hostnames) that labels cannot carry pod-portably.
         """
         env = environ if environ is not None else os.environ
         try:
@@ -122,7 +125,13 @@ class SliceTopology:
             hosts = int(labels[wk.TPU_HOSTS_LABEL])
             worker = int(labels.get(wk.TPU_WORKER_INDEX_LABEL,
                                     env.get(ENV_WORKER_ID, "0")))
-            slice_index = int(env.get("TPU_KAITO_SLICE_INDEX", "0"))
+            slice_index = int(
+                env.get("TPU_KAITO_SLICE_INDEX")
+                or labels.get(wk.TPU_SLICE_INDEX_LABEL, "0"))
+            if num_slices is None:
+                num_slices = int(
+                    env.get("TPU_KAITO_NUM_SLICES")
+                    or labels.get(wk.TPU_NUM_SLICES_LABEL, "1"))
         except KeyError as e:
             raise TopologyError(
                 f"node labels missing {e.args[0]!r} — was this node "
@@ -135,7 +144,8 @@ class SliceTopology:
                    worker_hostnames=hostnames, num_slices=num_slices,
                    slice_index=slice_index,
                    slice_group=labels.get(wk.TPU_SLICE_GROUP_LABEL, ""),
-                   coordinator=env.get("TPU_KAITO_COORDINATOR", ""))
+                   coordinator=(env.get("TPU_KAITO_COORDINATOR")
+                                or labels.get(wk.TPU_COORDINATOR_LABEL, "")))
 
     @classmethod
     def from_env(cls, environ: Optional[Mapping[str, str]] = None) -> "SliceTopology":
@@ -149,11 +159,9 @@ class SliceTopology:
             wk.TPU_HOSTS_LABEL: env.get("TPU_KAITO_HOSTS", ""),
         }
         labels = {k: v for k, v in labels.items() if v}
-        try:
-            num_slices = int(env.get("TPU_KAITO_NUM_SLICES", "1"))
-        except ValueError as e:
-            raise TopologyError(f"non-integer TPU_KAITO_NUM_SLICES: {e}")
-        return cls.from_node_labels(labels, environ=env, num_slices=num_slices)
+        # num_slices=None → from_node_labels reads TPU_KAITO_NUM_SLICES /
+        # the num-slices label itself (one parse path, one error message)
+        return cls.from_node_labels(labels, environ=env)
 
 
 def drop_foreign_backend_factories() -> None:

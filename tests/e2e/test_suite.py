@@ -199,7 +199,15 @@ async def test_operator_with_leader_election(tmp_path):
 
 @async_test
 async def test_multislice_group_provisions_n_slices(tmp_path):
-    """BASELINE config 5: 4× v5e-16 NodeClaims in one DCN slice group."""
+    """BASELINE config 5: 4× v5e-16 NodeClaims in one DCN slice group.
+
+    Beyond pool count, asserts the full bootstrap loop the provisioner must
+    close with NO manual env: distinct ordered slice indices on every pool's
+    nodes, one agreed coordinator, and SliceTopology.from_node_labels
+    yielding globally-unique jax.distributed process ids for every worker.
+    """
+    from gpu_provisioner_tpu.parallel.topology import SliceTopology
+
     async with Environment(tmp_path) as env:
         for i in range(4):
             nc = make_nodeclaim(f"slice{i}", "tpu-v5e-16",
@@ -213,3 +221,28 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
         assert groups == {"dpgroup"}
         pools = await env.cloud.nodepools.list()
         assert len(pools) == 4
+
+        # distinct ordered slice indices, stamped on every member's nodes
+        by_index = {}
+        for n in nodes:
+            idx = n.metadata.labels[wk.TPU_SLICE_INDEX_LABEL]
+            by_index.setdefault(idx, set()).add(
+                n.metadata.labels[wk.GKE_NODEPOOL_LABEL])
+        assert sorted(by_index) == ["0", "1", "2", "3"]
+        assert all(len(pools_) == 1 for pools_ in by_index.values())
+
+        # one agreed coordinator: worker 0 of slice 0
+        coords = {n.metadata.labels[wk.TPU_COORDINATOR_LABEL] for n in nodes}
+        (pool0,) = by_index["0"]
+        assert coords == {f"gke-kaito-{pool0}-w0"}
+
+        # every worker bootstraps jax.distributed args from labels alone
+        args_seen = []
+        for n in nodes:
+            topo = SliceTopology.from_node_labels(n.metadata.labels,
+                                                  environ={})
+            args = topo.distributed_init_args()
+            assert args["num_processes"] == 8
+            assert args["coordinator_address"] == f"gke-kaito-{pool0}-w0:8476"
+            args_seen.append(args["process_id"])
+        assert sorted(args_seen) == list(range(8))

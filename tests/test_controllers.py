@@ -196,3 +196,90 @@ async def test_queued_provisioning_end_to_end():
         nc = await env.wait_ready("qr0", timeout=10)
         assert nc.status_conditions.is_true(INITIALIZED)
         assert env.cloud.queuedresources.resources["qr0"].state == "ACTIVE"
+
+
+# --- slice-group identity convergence (controllers/slicegroup.py) ----------
+
+async def _poll(fn, timeout=10.0, what="condition"):
+    import time as _t
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        got = await fn()
+        if got:
+            return got
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f"{what} not met within {timeout}s")
+
+
+def _group_nodes(env, group):
+    async def get():
+        return await env.client.list(
+            Node, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+    return get
+
+
+@async_test
+async def test_slicegroup_identity_converges_on_incremental_join():
+    """A member joining an existing group re-stamps num-slices on every
+    node, not just the new member's (identity labels would otherwise be
+    frozen at each pool's create time)."""
+    async with Env() as env:
+        await env.client.create(make_nodeclaim(
+            "aa", "tpu-v5e-16", labels={wk.TPU_SLICE_GROUP_LABEL: "g"}))
+        await env.wait_ready("aa")
+
+        async def aa_stamped():
+            nodes = await _group_nodes(env, "g")()
+            return nodes if all(
+                n.metadata.labels.get(wk.TPU_NUM_SLICES_LABEL) == "1"
+                for n in nodes) and nodes else None
+        await _poll(aa_stamped, what="aa num-slices=1")
+
+        await env.client.create(make_nodeclaim(
+            "bb", "tpu-v5e-16", labels={wk.TPU_SLICE_GROUP_LABEL: "g"}))
+        await env.wait_ready("bb")
+
+        async def converged():
+            nodes = await _group_nodes(env, "g")()
+            ok = len(nodes) == 4 and all(
+                n.metadata.labels.get(wk.TPU_NUM_SLICES_LABEL) == "2"
+                and n.metadata.labels.get(wk.TPU_COORDINATOR_LABEL)
+                == "gke-kaito-aa-w0" for n in nodes)
+            return nodes if ok else None
+        await _poll(converged, what="group converged to num-slices=2")
+
+
+@async_test
+async def test_slicegroup_coordinator_repaired_after_slice0_replacement():
+    """Slice 0's pool deleted and replaced under a new claim name: the new
+    claim takes the free index 0 and survivors' nodes are re-pointed at the
+    new coordinator."""
+    async with Env() as env:
+        for name in ("aa", "bb"):
+            await env.client.create(make_nodeclaim(
+                name, "tpu-v5e-16", labels={wk.TPU_SLICE_GROUP_LABEL: "g"}))
+        for name in ("aa", "bb"):
+            await env.wait_ready(name)
+
+        await env.client.delete(NodeClaim, "aa")
+
+        async def aa_gone():
+            nodes = await _group_nodes(env, "g")()
+            mine = [n for n in nodes if "aa" in n.metadata.name]
+            return not mine or None
+        await _poll(aa_gone, what="aa nodes removed")
+
+        await env.client.create(make_nodeclaim(
+            "cc", "tpu-v5e-16", labels={wk.TPU_SLICE_GROUP_LABEL: "g"}))
+        await env.wait_ready("cc")
+
+        async def repaired():
+            nodes = await _group_nodes(env, "g")()
+            cc = [n for n in nodes if "cc" in n.metadata.name]
+            ok = (cc and all(
+                n.metadata.labels.get(wk.TPU_SLICE_INDEX_LABEL) == "0"
+                for n in cc) and all(
+                n.metadata.labels.get(wk.TPU_COORDINATOR_LABEL)
+                == "gke-kaito-cc-w0" for n in nodes))
+            return nodes if ok else None
+        await _poll(repaired, what="coordinator repointed to cc")

@@ -198,3 +198,70 @@ def test_parse_nodepool_from_provider_id():
     assert parse_nodepool_from_provider_id(pid, "kaito") == "myws"
     assert parse_nodepool_from_provider_id(pid, "other") is None
     assert parse_nodepool_from_provider_id("azure:///x", "kaito") is None
+
+
+# --- multi-slice identity (slice-index / num-slices / coordinator) ---------
+
+def _identity(cloud, pool):
+    labels = cloud.nodepools.pools[pool].config.labels
+    return (labels.get(wk.TPU_SLICE_INDEX_LABEL),
+            labels.get(wk.TPU_NUM_SLICES_LABEL),
+            labels.get(wk.TPU_COORDINATOR_LABEL))
+
+
+@async_test
+async def test_multislice_identity_deterministic_any_create_order():
+    """All group members exist before reconcile (KAITO creates the group
+    together); indices follow (creationTimestamp, name) order regardless of
+    which reconciler runs first, and everyone agrees on the coordinator."""
+    kube, cloud, provider = setup()
+    claims = [make_nodeclaim(f"sl{i}", "tpu-v5e-16",
+                             labels={wk.TPU_SLICE_GROUP_LABEL: "g1"})
+              for i in range(3)]
+    for c in claims:
+        await kube.create(c)
+    await provider.create(claims[2])   # out-of-order reconcile
+    await provider.create(claims[0])
+    await provider.create(claims[1])
+    assert _identity(cloud, "sl0") == ("0", "3", "gke-kaito-sl0-w0")
+    assert _identity(cloud, "sl1") == ("1", "3", "gke-kaito-sl0-w0")
+    assert _identity(cloud, "sl2") == ("2", "3", "gke-kaito-sl0-w0")
+
+
+@async_test
+async def test_multislice_identity_sticky_and_fills_gaps():
+    """An index stamped on an existing pool is authoritative; new members
+    take the lowest free index."""
+    kube, cloud, provider = setup()
+    a = make_nodeclaim("aa", "tpu-v5e-16",
+                       labels={wk.TPU_SLICE_GROUP_LABEL: "g2"})
+    b = make_nodeclaim("bb", "tpu-v5e-16",
+                       labels={wk.TPU_SLICE_GROUP_LABEL: "g2"})
+    await kube.create(a)
+    await provider.create(a)                     # aa -> 0
+    assert _identity(cloud, "aa")[0] == "0"
+    await kube.create(b)
+    await provider.create(b)                     # bb -> 1 (0 taken)
+    assert _identity(cloud, "bb")[0] == "1"
+    # re-reconcile of aa keeps its index (sticky), even though bb now exists
+    identity = await provider._slice_group_identity(a)
+    assert identity[wk.TPU_SLICE_INDEX_LABEL] == "0"
+    assert identity[wk.TPU_COORDINATOR_LABEL] == "gke-kaito-aa-w0"
+
+
+@async_test
+async def test_multislice_identity_declared_group_size_wins():
+    kube, cloud, provider = setup()
+    nc = make_nodeclaim("solo", "tpu-v5e-16",
+                        labels={wk.TPU_SLICE_GROUP_LABEL: "g3",
+                                wk.TPU_NUM_SLICES_LABEL: "4"})
+    await kube.create(nc)
+    await provider.create(nc)
+    assert _identity(cloud, "solo") == ("0", "4", "gke-kaito-solo-w0")
+
+
+@async_test
+async def test_no_slice_group_no_identity_labels():
+    kube, cloud, provider = setup()
+    await provider.create(make_nodeclaim("plain", "tpu-v5e-8"))
+    assert _identity(cloud, "plain") == (None, None, None)

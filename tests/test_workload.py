@@ -61,6 +61,23 @@ def test_topology_from_env_and_distributed_args():
                     "num_processes": 8, "process_id": 5}
 
 
+def test_topology_multislice_from_labels_alone():
+    """The provisioner-stamped identity labels bootstrap jax.distributed
+    with NO env (providers/instance.py:_slice_group_identity)."""
+    shape = catalog.lookup("v5e-16")
+    labels = shape.node_labels(slice_id="sl2")
+    labels[wk.TPU_WORKER_INDEX_LABEL] = "1"
+    labels[wk.TPU_SLICE_GROUP_LABEL] = "g"
+    labels[wk.TPU_SLICE_INDEX_LABEL] = "2"
+    labels[wk.TPU_NUM_SLICES_LABEL] = "4"
+    labels[wk.TPU_COORDINATOR_LABEL] = "gke-kaito-sl0-w0"
+    topo = SliceTopology.from_node_labels(labels, environ={})
+    assert (topo.slice_index, topo.num_slices, topo.worker_index) == (2, 4, 1)
+    assert topo.distributed_init_args() == {
+        "coordinator_address": "gke-kaito-sl0-w0:8476",
+        "num_processes": 8, "process_id": 5}
+
+
 def test_topology_multislice_requires_coordinator():
     topo = SliceTopology(generation="v5e", topology="4x4", chips=16, hosts=2,
                          worker_hostnames=("h0", "h1"), num_slices=2)
