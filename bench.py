@@ -110,6 +110,117 @@ def bench_workload(fast: bool) -> dict:
             "step_ms": best * 1e3}
 
 
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets); MFU is
+# reported against the attached chip's peak.
+_PEAK_BF16 = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+              "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12}
+
+
+def _chip_peak(dev) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return _PEAK_BF16["v5e"]  # conservative default
+
+
+def _train_flops(params, cfg, batch: int, seq: int) -> float:
+    """Model FLOPs per train step (fwd+bwd ≈ 3× fwd): 6·P per token for the
+    matmuls + causal attention scores/values (2·B·S²·H·Dh fwd, ×3)."""
+    import jax
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    matmul = 6.0 * n_params * batch * seq
+    # attention: QK^T + PV are 2 matmuls -> 4*B*S^2*H*Dh fwd, x3 with the
+    # backward = 12x; causal halves the live square
+    attn = 12.0 * batch * seq * seq * cfg.n_heads * cfg.head_dim * \
+        cfg.n_layers * 0.5
+    return matmul + attn
+
+
+def bench_train_step(fast: bool) -> dict:
+    """Full train step (forward + backward + adamw update) with the Pallas
+    flash kernel + remat — the north-star workload — and its MFU."""
+    import jax
+    from gpu_provisioner_tpu.models.llama import LlamaConfig
+    from gpu_provisioner_tpu.models.train import (BATCH_SPEC, make_train_state,
+                                                  make_train_step)
+    from gpu_provisioner_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding
+
+    dev = jax.devices()[0]
+    # Pallas interpret mode (CPU) is far too slow for a whole train step;
+    # the kernel path only engages on a real TPU backend.
+    impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
+    cfg = (LlamaConfig(vocab_size=2048, dim=512, n_layers=4, n_heads=8,
+                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16",
+                       attn_impl=impl, remat=True)
+           if fast else
+           LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16",
+                       attn_impl=impl, remat=True))
+    B, S = (4, 512) if fast else (8, 2048)
+    mesh = make_mesh(1, devices=[dev])
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    step = make_train_step(mesh, cfg, opt)
+    toks = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    inp, tgt = put(toks[:, :-1]), put(toks[:, 1:])
+
+    def settle(loss):
+        loss.block_until_ready()
+        return float(loss)
+
+    for _ in range(2):                               # compile + settle
+        params, opt_state, loss = step(params, opt_state, inp, tgt)
+        settle(loss)
+    iters = 5
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, inp, tgt)
+        settle(loss)
+        best = min(best, (time.perf_counter() - t0) / iters)
+
+    flops = _train_flops(params, cfg, B, S)
+    mfu = flops / best / _chip_peak(dev)
+    return {"platform": dev.platform, "batch": B, "seq_len": S,
+            "step_ms": best * 1e3, "tokens_per_s": B * S / best, "mfu": mfu}
+
+
+def bench_long_context(fast: bool) -> dict:
+    """Flash + remat trains at S=8192 on one chip, where dense recompute
+    cannot (the S² score matrix alone is 2.1 GB/head-batch in f32)."""
+    import jax
+    from gpu_provisioner_tpu.models.llama import LlamaConfig
+    from gpu_provisioner_tpu.models.train import (BATCH_SPEC, make_train_state,
+                                                  make_train_step)
+    from gpu_provisioner_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding
+
+    dev = jax.devices()[0]
+    impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
+    S = 2048 if fast else 8192
+    cfg = LlamaConfig(vocab_size=2048, dim=1024, n_layers=4, n_heads=8,
+                      n_kv_heads=4, hidden_dim=2816, max_seq_len=S,
+                      dtype="bfloat16", attn_impl=impl, remat=True)
+    mesh = make_mesh(1, devices=[dev])
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    step = make_train_step(mesh, cfg, opt)
+    toks = jax.random.randint(jax.random.key(1), (1, S + 1), 0, cfg.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    inp, tgt = put(toks[:, :-1]), put(toks[:, 1:])
+
+    params, opt_state, loss = step(params, opt_state, inp, tgt)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, inp, tgt)
+    loss.block_until_ready()
+    float(loss)
+    return {"seq_len": S, "step_ms": (time.perf_counter() - t0) * 1e3}
+
+
 def bench_flash_op(fast: bool) -> dict:
     """Pallas flash-attention kernel vs the dense lax path, one op."""
     import jax
@@ -145,6 +256,23 @@ def bench_flash_op(fast: bool) -> dict:
             "flash_speedup": dense_ms / flash_ms}
 
 
+def _accelerator_usable(timeout_s: float = 240.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS: a wedged PJRT client (e.g. a
+    dead tunnel) hangs jax.devices() uninterruptibly in C, which would turn
+    the whole bench into a silent hang instead of a JSON line. A subprocess
+    is killable; first TPU compile can be slow, hence the generous budget."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp; jax.devices(); "
+            "x = jnp.ones((128, 128), jnp.bfloat16); print(float((x @ x)[0, 0]))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fast", action="store_true", help="small sizes (CI/verify)")
@@ -158,15 +286,24 @@ def main(argv=None) -> int:
     prov = asyncio.run(bench_provisioning(n, args.shape))
     extra = {k: round(v, 4) if isinstance(v, float) else v
              for k, v in prov.items() if k != "p50_s"}
+    if not args.no_tpu and not _accelerator_usable():
+        extra["workload_error"] = "accelerator probe failed or hung; skipped"
+        args.no_tpu = True
     if not args.no_tpu:
+        def rounded(d, nd=2):
+            return {k: round(v, nd) if isinstance(v, float) else v
+                    for k, v in d.items()}
+
         try:
-            extra["workload"] = {k: round(v, 2) if isinstance(v, float) else v
-                                 for k, v in bench_workload(args.fast).items()}
-            extra["flash_attention"] = {
-                k: round(v, 2) if isinstance(v, float) else v
-                for k, v in bench_flash_op(args.fast).items()}
+            extra["workload"] = rounded(bench_workload(args.fast))
+            extra["flash_attention"] = rounded(bench_flash_op(args.fast))
         except Exception as e:  # no usable accelerator — control plane still counts
             extra["workload_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra["train"] = rounded(bench_train_step(args.fast), 4)
+            extra["long_context"] = rounded(bench_long_context(args.fast))
+        except Exception as e:
+            extra["train_error"] = f"{type(e).__name__}: {e}"
 
     p50 = prov["p50_s"]
     print(json.dumps({

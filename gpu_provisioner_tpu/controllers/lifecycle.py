@@ -143,15 +143,29 @@ class NodeClaimLifecycleController:
             obj.status = nc.status
 
         def copy_meta(obj):
-            if (obj.metadata.labels == nc.metadata.labels
-                    and obj.metadata.annotations == nc.metadata.annotations):
-                return False
-            obj.metadata.labels = dict(nc.metadata.labels)
-            obj.metadata.annotations = dict(nc.metadata.annotations)
+            # Additive merge, NEVER wholesale replace: a concurrent reconcile
+            # whose snapshot predates the launch label-merge would otherwise
+            # clobber the labels launch just flushed (found as a real lost
+            # update — claim Ready without its topology labels — since
+            # _launch early-returns once Launched and never re-merges).
+            changed = False
+            for k, v in nc.metadata.labels.items():
+                if obj.metadata.labels.get(k) != v:
+                    obj.metadata.labels[k] = v
+                    changed = True
+            for k, v in nc.metadata.annotations.items():
+                if obj.metadata.annotations.get(k) != v:
+                    obj.metadata.annotations[k] = v
+                    changed = True
+            return None if changed else False
         try:
+            # Meta BEFORE status: conditions (incl. Ready) must never be
+            # observable while the launch-merged labels are still unwritten —
+            # a reader acting on Ready would see a claim without its topology
+            # labels, and _launch never re-merges once Launched persists.
+            await patch_retry(self.client, NodeClaim, nc.metadata.name, copy_meta)
             await patch_retry(self.client, NodeClaim, nc.metadata.name, copy_status,
                               status=True)
-            await patch_retry(self.client, NodeClaim, nc.metadata.name, copy_meta)
         except ConflictError:
             pass  # next reconcile sees fresh state
 

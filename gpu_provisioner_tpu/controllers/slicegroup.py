@@ -73,13 +73,14 @@ class SliceGroupController:
         num_slices = declared or max(len(pool_index), len(claims),
                                      max(pool_index.values()) + 1)
 
+        from ..providers.instance import instance_name
+
         desired = {wk.TPU_NUM_SLICES_LABEL: str(num_slices)}
         owner0 = next((p for p, i in pool_index.items() if i == 0), None)
         if owner0 is not None:
-            # GKE instance naming convention — worker 0 of the slice-0 pool
-            # (providers/instance.py:instance_name)
-            desired[wk.TPU_COORDINATOR_LABEL] = \
-                f"gke-{self.cluster}-{owner0}-w0"
+            # worker 0 of the slice-0 pool, via the one naming-convention seam
+            desired[wk.TPU_COORDINATOR_LABEL] = instance_name(
+                self.cluster, owner0, 0)
 
         for n in nodes:
             if all(n.metadata.labels.get(k) == v for k, v in desired.items()):

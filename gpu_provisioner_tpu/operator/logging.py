@@ -41,3 +41,7 @@ def setup_logging(level: str = "info", stream=None) -> None:
     root = logging.getLogger()
     root.handlers[:] = [handler]
     root.setLevel(_LEVELS.get(level.lower(), logging.INFO))
+    # HTTP wire-level spam drowns the operator's own lines at debug level
+    # (200 lines of httpcore per reconcile); these stay at WARNING always.
+    for noisy in ("httpcore", "httpx"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)

@@ -17,8 +17,14 @@ variants trade HBM traffic against VMEM:
 GQA costs no memory: the KV BlockSpec index_map points q-head ``bh`` at
 kv-head ``bh // group`` — no repeat materialization.
 
-Backward pass: flash forward + dense recompute backward via custom_vjp —
-exact gradients, with the dense memory cost paid only inside the backward.
+Backward pass: FlashAttention-2-style per-block recompute Pallas kernels
+(no S×S materialization, so training memory is O(S·D) like the forward):
+the forward also emits the per-row logsumexp L, the backward precomputes
+Δ = rowsum(dO∘O) and runs two passes — a dQ kernel (grid over q-blocks,
+accumulating over kv-blocks in VMEM scratch) and a dK/dV kernel (grid over
+kv-blocks, accumulating over q-blocks), each rebuilding P = exp(S−L) from
+the tiles. GQA folds the per-q-head dK/dV back onto kv-heads outside the
+kernel.
 
 Falls back to the lax dense path when S doesn't tile into the (aligned)
 block sizes; ``interpret=True`` runs the same kernel on CPU for tests.
@@ -44,7 +50,7 @@ DEFAULT_BLOCK = 128
 RESIDENT_KV_BUDGET = 6 * 1024 * 1024
 
 
-def _kernel_resident(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
                      seq_len, scale, causal):
     """Whole-K/V-in-VMEM variant: one DMA of K/V per (bh, q-block), inner
     fori_loop over tiles. Fastest at short/medium S (fewer HBM round trips,
@@ -85,9 +91,11 @@ def _kernel_resident(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
+    lse_ref[...] = lse.reshape(1, block_q)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             block_q, block_k, scale, causal):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -132,17 +140,32 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = l_ref[:]
         o_ref[0] = (acc_ref[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+        m = m_ref[:]
+        lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
+        lse_ref[...] = lse.reshape(1, block_q)
+
+
+def _heads_to_rows(x):
+    """[B, S, H, D] → [B*H, S, D] so each grid cell owns one head's sequence."""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _rows_to_heads(x, B, H):
+    BH, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Flash forward on flattened heads → (out [B,S,Hq,D], lse [B*Hq, S])."""
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
 
-    # [B, S, H, D] → [B*H, S, D] so each grid cell owns one head's sequence
-    qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
+    qf, kf, vf = _heads_to_rows(q), _heads_to_rows(k), _heads_to_rows(v)
+
+    out_shapes = [jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+                  jax.ShapeDtypeStruct((B * Hq, S), jnp.float32)]
 
     # bh = b*Hq + h → kv row b*Hkv + h//group == bh // group (Hq = Hkv·group)
     kv_bytes = 2 * S * D * jnp.dtype(q.dtype).itemsize
@@ -150,7 +173,7 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
         kernel = functools.partial(
             _kernel_resident, block_q=block_q, block_k=block_k, seq_len=S,
             scale=scale, causal=causal)
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
             grid=(B * Hq, S // block_q),
             in_specs=[
@@ -161,17 +184,20 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
                 pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda bh, qi: (bh, qi, 0),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=out_shapes,
             interpret=interpret,
         )(qf, kf, vf)
-        return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+        return _rows_to_heads(out, B, Hq), lse
 
     kernel = functools.partial(
         _kernel, block_q=block_q, block_k=block_k, scale=scale, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * Hq, S // block_q, S // block_k),
         in_specs=[
@@ -184,9 +210,13 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
                          lambda bh, qi, kj, g=group: (bh // g, kj, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -194,24 +224,191 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+    return _rows_to_heads(out, B, Hq), lse
+
+
+# --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, block_q, block_k, scale, causal):
+    """dQ_i = scale · Σ_j [P_ij ∘ (dO_i V_jᵀ − Δ_i)] K_j, accumulated over
+    kv-blocks in VMEM scratch. P is rebuilt from the saved logsumexp."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                    # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                  # [BQ, D]
+        lse = lse_ref[0].reshape(block_q, 1)                # [BQ, 1]
+        delta = delta_ref[0].reshape(block_q, 1)            # [BQ, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(lse > NEG_INF / 2, p, 0.0)            # fully-masked rows
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                    scale, causal):
+    """dV_j = Σ_i P_ijᵀ dO_i ; dK_j = scale · Σ_i [P ∘ (dP − Δ)]ᵀ Q_i,
+    accumulated over q-blocks. Grid is (bh, kv-block, q-block)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                    # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(block_q, 1)
+        delta = delta_ref[0].reshape(block_q, 1)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(lse > NEG_INF / 2, p, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+
+    qf, kf, vf = _heads_to_rows(q), _heads_to_rows(k), _heads_to_rows(v)
+    dof = _heads_to_rows(g)
+    of = _heads_to_rows(o)
+    # Δ_i = rowsum(dO ∘ O) — cheap elementwise, XLA fuses it
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, block_k, D),
+                          lambda bh, qi, kj, g_=group: (bh // g_, kj, 0),
+                          memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi),
+                        memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(B * Hq, S // block_q, S // block_k),
+        in_specs=[qspec, kvspec, kvspec, qspec, rowq, rowq],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dK/dV per q-head (grid bh spans B*Hq); GQA folds group q-heads onto
+    # their kv-head after the kernel — keeps grid cells race-free.
+    qspec2 = pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0),
+                          memory_space=pltpu.VMEM)
+    kvspec2 = pl.BlockSpec((1, block_k, D),
+                           lambda bh, kj, qi, g_=group: (bh // g_, kj, 0),
+                           memory_space=pltpu.VMEM)
+    rowq2 = pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi),
+                         memory_space=pltpu.VMEM)
+    dkv_out = pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0),
+                           memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(B * Hq, S // block_k, S // block_q),
+        in_specs=[qspec2, kvspec2, kvspec2, qspec2, rowq2, rowq2],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[jax.ShapeDtypeStruct((B * Hq, S, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B * Hq, S, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(B, Hkv, group, S, D).sum(axis=2).reshape(B * Hkv, S, D)
+        dv = dv.reshape(B, Hkv, group, S, D).sum(axis=2).reshape(B * Hkv, S, D)
+
+    return (_rows_to_heads(dq, B, Hq),
+            _rows_to_heads(dk.astype(k.dtype), B, Hkv),
+            _rows_to_heads(dv.astype(v.dtype), B, Hkv))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal,
-                                           scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q,
+                           block_k, interpret)
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)

@@ -75,10 +75,12 @@ def initialize_distributed(topo: SliceTopology) -> None:
         return
     import jax
 
-    state = getattr(getattr(jax, "_src", None), "distributed", None)
-    if state is not None and getattr(state.global_state, "client", None):
-        return
-    jax.distributed.initialize(**topo.distributed_init_args())
+    try:
+        jax.distributed.initialize(**topo.distributed_init_args())
+    except RuntimeError as e:
+        if "already" in str(e).lower():  # double-init (e.g. bootstrap retry)
+            return
+        raise
 
 
 async def bootstrap(environ: Optional[Mapping[str, str]] = None,

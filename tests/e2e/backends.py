@@ -118,8 +118,32 @@ class FakeKubeAPIServer:
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         await resp.prepare(req)
+        # Register the live queue FIRST, then snapshot the backlog — both
+        # before any await — so an object created between the client's LIST
+        # (which handed it this resourceVersion) and this registration is
+        # replayed rather than lost. Ignoring the rv here was a real found
+        # bug: a claim created in that gap never reconciled (ListAndWatch
+        # has no periodic resync to recover it).
         q = self.store.watch(cls, initial_list=False)
+        backlog = []
+        rv_param = req.query.get("resourceVersion", "")
+        if rv_param:
+            try:
+                since = int(rv_param)
+            except ValueError:
+                since = 0
+            for o in self.store.list(cls):
+                try:
+                    orv = int(o.metadata.resource_version or "0")
+                except ValueError:
+                    orv = 0
+                if orv > since:
+                    backlog.append(o)
         try:
+            for o in backlog:  # duplicates are fine — level-triggered clients
+                line = json.dumps({"type": "ADDED",
+                                   "object": o.to_dict()}) + "\n"
+                await resp.write(line.encode())
             while True:
                 try:
                     ev = await asyncio.wait_for(q.get(), timeout=0.5)
