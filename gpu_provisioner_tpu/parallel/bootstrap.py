@@ -75,11 +75,19 @@ def initialize_distributed(topo: SliceTopology) -> None:
         return
     import jax
 
+    # Best-effort pre-check (private API — tolerate its absence), then a
+    # message-based guard: jax 0.9 raises RuntimeError("distributed.initialize
+    # should only be called once."), older versions say "already initialized".
+    state = getattr(getattr(jax, "_src", None), "distributed", None)
+    if state is not None and getattr(
+            getattr(state, "global_state", None), "client", None) is not None:
+        return
     try:
         jax.distributed.initialize(**topo.distributed_init_args())
     except RuntimeError as e:
-        if "already" in str(e).lower():  # double-init (e.g. bootstrap retry)
-            return
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return  # double-init (e.g. bootstrap retry)
         raise
 
 

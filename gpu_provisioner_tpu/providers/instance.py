@@ -341,16 +341,28 @@ class InstanceProvider:
     async def _wait_for_nodes(self, pool: str, hosts: int) -> list[Node]:
         """Wait for all hosts' Node objects to exist with providerIDs
         (generalizes instance.go:124-149; correlation by the GKE node-pool
-        label, the analog of getNodesByName's agentpool labels :371-385)."""
+        label, the analog of getNodesByName's agentpool labels :371-385).
+
+        Polls back off exponentially (base interval ×1.5, capped) within the
+        attempts×interval time budget: a provisioning wave of hundreds of
+        concurrent creates polling at the base rate melts the apiserver/event
+        loop, and a miss here is retryable anyway (NodesNotReady → workqueue
+        backoff owns the longer wait)."""
         attempts = self.cfg.node_wait_attempts + 5 * (hosts - 1)
+        budget = attempts * self.cfg.node_wait_interval
+        deadline = asyncio.get_event_loop().time() + budget
+        interval = self.cfg.node_wait_interval
         ready: list[Node] = []
-        for _ in range(attempts):
+        while True:
             nodes = await self._nodes_of_pool(pool)
             ready = [n for n in nodes if n.spec.provider_id]
             if len(ready) >= hosts:
                 return sorted(ready, key=worker_index)
-            await asyncio.sleep(self.cfg.node_wait_interval
+            if asyncio.get_event_loop().time() >= deadline:
+                break
+            await asyncio.sleep(interval
                                 * (1 + random.random() * self.cfg.node_wait_jitter))
+            interval = min(interval * 1.5, budget / 4)
         raise CreateError(
             f"nodepool {pool}: only {len(ready)}/{hosts} nodes appeared with "
             "providerIDs before timeout", reason="NodesNotReady")

@@ -190,20 +190,43 @@ class RestClient:
                                resource_path(cls, namespace, name))
         return object_from_manifest(resp.json())
 
+    # Server-side page size for every LIST: bounds apiserver + client memory
+    # the way the reference's cached informer client bounds reads (options.go
+    # QPS 200/burst 300 govern writes; pagination governs reads). GC over
+    # thousands of Nodes no longer does one unbounded full list.
+    LIST_PAGE_SIZE = 500
+
+    async def list_pages(self, cls: type, params: Optional[dict] = None,
+                         namespace: str = ""):
+        """Async iterator over LIST page bodies (limit/continue chunking) —
+        the one pagination walk, shared by list() and the watch re-list."""
+        params = dict(params or {})
+        cont = ""
+        while True:
+            page = dict(params, limit=str(self.LIST_PAGE_SIZE))
+            if cont:
+                page["continue"] = cont
+            resp = await self._req("list", "GET",
+                                   resource_path(cls, namespace), params=page)
+            body = resp.json()
+            for item in body.get("items", []):
+                item.setdefault("kind", cls.KIND)
+                item.setdefault("apiVersion", cls.API_VERSION)
+            yield body
+            cont = body.get("metadata", {}).get("continue", "")
+            if not cont:
+                return
+
     async def list(self, cls: type, labels: Optional[dict[str, str]] = None,
                    namespace: Optional[str] = None,
                    index: Optional[tuple[str, str]] = None) -> list[Object]:
-        params = {}
+        params: dict[str, str] = {}
         if labels:
             params["labelSelector"] = ",".join(f"{k}={v}" for k, v in labels.items())
-        resp = await self._req("list", "GET",
-                               resource_path(cls, namespace or ""),
-                               params=params)
         items = []
-        for item in resp.json().get("items", []):
-            item.setdefault("kind", cls.KIND)
-            item.setdefault("apiVersion", cls.API_VERSION)
-            items.append(cls.from_dict(item))
+        async for body in self.list_pages(cls, params, namespace or ""):
+            for item in body.get("items", []):
+                items.append(cls.from_dict(item))
         if index is not None:
             name, value = index
             key_fn = self._indexes.get((cls, name))
@@ -310,14 +333,12 @@ class RestWatch:
                 await asyncio.sleep(self.RECONNECT_BACKOFF)
 
     async def _list_into_queue(self) -> str:
-        resp = await self.client._req("list", "GET",
-                                      resource_path(self.cls))
-        body = resp.json()
-        for item in body.get("items", []):
-            item.setdefault("kind", self.cls.KIND)
-            item.setdefault("apiVersion", self.cls.API_VERSION)
-            self._q.put_nowait(WatchEvent(ADDED, self.cls.from_dict(item)))
-        return body.get("metadata", {}).get("resourceVersion", "")
+        rv = ""
+        async for body in self.client.list_pages(self.cls):
+            for item in body.get("items", []):
+                self._q.put_nowait(WatchEvent(ADDED, self.cls.from_dict(item)))
+            rv = body.get("metadata", {}).get("resourceVersion", "") or rv
+        return rv
 
     async def _stream(self, rv: str) -> str:
         params = {"watch": "true", "allowWatchBookmarks": "true",

@@ -64,6 +64,15 @@ class Store:
         self._rv = itertools.count(1)
         self._watchers: dict[type, list[asyncio.Queue]] = {}
         self._indexes: dict[tuple[type, str], object] = {}  # (cls, name) -> key_fn
+        # Maintained inverted indexes — the informer-cache behavior that keeps
+        # hot-path reads O(result) instead of O(bucket): registered field
+        # indexes and every label key/value map to object keys, updated on
+        # each mutation. Without these, per-claim node-wait polls and
+        # per-reconcile providerID lookups scan the whole bucket, which is
+        # O(claims²) during a provisioning wave (found: 64 claims fine,
+        # 128 melted down).
+        self._inverted: dict[tuple[type, str], dict[str, set]] = {}
+        self._by_label: dict[type, dict[tuple[str, str], set]] = {}
 
     # -- watch ------------------------------------------------------------
     def watch(self, cls: type, initial_list: bool = True) -> asyncio.Queue:
@@ -97,6 +106,33 @@ class Store:
         """Field indexer analog (reference: operator.go:263-293 registers pod
         nodeName / node providerID / nodeclaim providerID indexes)."""
         self._indexes[(cls, name)] = key_fn
+        inv: dict[str, set] = {}
+        for k, obj in self._bucket(cls).items():
+            for val in (key_fn(obj) or []):
+                inv.setdefault(val, set()).add(k)
+        self._inverted[(cls, name)] = inv
+
+    def _index_add(self, obj: Object, k: tuple[str, str]) -> None:
+        cls = type(obj)
+        for (icls, name), fn in self._indexes.items():
+            if icls is cls:
+                inv = self._inverted[(icls, name)]
+                for val in (fn(obj) or []):
+                    inv.setdefault(val, set()).add(k)
+        lab = self._by_label.setdefault(cls, {})
+        for lk, lv in obj.metadata.labels.items():
+            lab.setdefault((lk, lv), set()).add(k)
+
+    def _index_remove(self, obj: Object, k: tuple[str, str]) -> None:
+        cls = type(obj)
+        for (icls, name), fn in self._indexes.items():
+            if icls is cls:
+                inv = self._inverted[(icls, name)]
+                for val in (fn(obj) or []):
+                    inv.get(val, set()).discard(k)
+        lab = self._by_label.get(cls, {})
+        for lk, lv in obj.metadata.labels.items():
+            lab.get((lk, lv), set()).discard(k)
 
     # -- CRUD -------------------------------------------------------------
     def _bucket(self, cls: type) -> dict[tuple[str, str], Object]:
@@ -113,6 +149,7 @@ class Store:
         stored.metadata.generation = 1
         stored.metadata.resource_version = str(next(self._rv))
         b[k] = stored
+        self._index_add(stored, k)
         self._notify(ADDED, stored)
         return stored.deepcopy()
 
@@ -125,18 +162,26 @@ class Store:
     def list(self, cls: type, labels: Optional[dict[str, str]] = None,
              namespace: Optional[str] = None,
              index: Optional[tuple[str, str]] = None) -> list[Object]:
+        bucket = self._bucket(cls)
+        # narrow to index candidates first — O(result), not O(bucket)
+        if index:
+            if (cls, index[0]) not in self._indexes:
+                raise StoreError(f"no index {index[0]!r} registered for {cls.__name__}")
+            keys = self._inverted[(cls, index[0])].get(index[1], set())
+            candidates = [bucket[k] for k in keys if k in bucket]
+        elif labels:
+            lk, lv = next(iter(labels.items()))
+            keys = self._by_label.get(cls, {}).get((lk, lv), set())
+            candidates = [bucket[k] for k in keys if k in bucket]
+        else:
+            candidates = bucket.values()
+
         out = []
-        key_fn = self._indexes.get((cls, index[0])) if index else None
-        for obj in self._bucket(cls).values():
+        for obj in candidates:
             if namespace is not None and obj.metadata.namespace != namespace:
                 continue
             if labels and any(obj.metadata.labels.get(k) != v for k, v in labels.items()):
                 continue
-            if index:
-                if key_fn is None:
-                    raise StoreError(f"no index {index[0]!r} registered for {cls.__name__}")
-                if index[1] not in (key_fn(obj) or []):
-                    continue
             out.append(obj.deepcopy())
         return out
 
@@ -171,11 +216,13 @@ class Store:
         else:
             stored.metadata.generation = current.metadata.generation
         stored.metadata.resource_version = str(next(self._rv))
+        self._index_remove(current, k)
         if stored.metadata.deletion_timestamp and not stored.metadata.finalizers:
             del b[k]
             self._notify(DELETED, stored)
             return stored.deepcopy()
         b[k] = stored
+        self._index_add(stored, k)
         self._notify(MODIFIED, stored)
         return stored.deepcopy()
 
@@ -207,6 +254,7 @@ class Store:
                 self._notify(MODIFIED, current)
             return
         del b[k]
+        self._index_remove(current, k)
         self._notify(DELETED, current)
 
 

@@ -27,6 +27,14 @@ class RateLimitingQueue:
         self._seq = 0
         self._cond = asyncio.Condition()
         self._shutdown = False
+        # ONE timer task owns the delayed heap's deadline; workers block on
+        # the condition with no timeout. The previous design had every idle
+        # worker wake on the next-due deadline — with ~1000 workers
+        # (the reference's concurrency regime) that thundering herd of
+        # wait_for timers + lock reacquisitions saturated the event loop
+        # before any real work ran.
+        self._timer: Optional[asyncio.Task] = None
+        self._timer_wake = asyncio.Event()
 
     # -- core add/get/done ------------------------------------------------
     def _add_locked(self, item: Hashable) -> None:
@@ -47,9 +55,33 @@ class RateLimitingQueue:
             await self.add(item)
             return
         async with self._cond:
+            if self._shutdown:
+                return
             self._seq += 1
             heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
-            self._cond.notify()
+            if self._timer is None or self._timer.done():
+                self._timer = asyncio.create_task(self._timer_loop())
+            else:
+                self._timer_wake.set()  # new item may be due earlier
+
+    async def _timer_loop(self) -> None:
+        """Drain due delayed items into the ready queue, sleeping until the
+        next deadline; exits when the heap empties (re-armed by add_after)."""
+        while True:
+            async with self._cond:
+                if self._shutdown:
+                    return
+                nxt = self._drain_delayed_locked()
+                if self._queue:
+                    self._cond.notify(len(self._queue))
+                if nxt is None:
+                    self._timer = None
+                    return
+            self._timer_wake.clear()
+            try:
+                await asyncio.wait_for(self._timer_wake.wait(), timeout=nxt)
+            except asyncio.TimeoutError:
+                pass
 
     async def add_rate_limited(self, item: Hashable) -> None:
         async with self._cond:
@@ -81,7 +113,7 @@ class RateLimitingQueue:
     async def get(self) -> Any:
         async with self._cond:
             while True:
-                timeout = self._drain_delayed_locked()
+                self._drain_delayed_locked()  # cheap catch-up; timer notifies
                 if self._queue:
                     item = self._queue.pop(0)
                     self._dirty.discard(item)
@@ -89,10 +121,7 @@ class RateLimitingQueue:
                     return item
                 if self._shutdown:
                     raise asyncio.CancelledError("workqueue shut down")
-                try:
-                    await asyncio.wait_for(self._cond.wait(), timeout)
-                except asyncio.TimeoutError:
-                    continue
+                await self._cond.wait()
 
     async def done(self, item: Hashable) -> None:
         async with self._cond:
@@ -104,6 +133,7 @@ class RateLimitingQueue:
     async def shutdown(self) -> None:
         async with self._cond:
             self._shutdown = True
+            self._timer_wake.set()
             self._cond.notify_all()
 
     def __len__(self) -> int:

@@ -108,11 +108,27 @@ class FakeKubeAPIServer:
         if sel:
             labels = dict(p.split("=", 1) for p in sel.split(","))
         items = self.store.list(cls, labels, ns or None)
+        # limit/continue pagination with a KEYSET cursor (last ns/name seen),
+        # not a positional index: concurrent deletes shift positions and a
+        # positional cursor silently skips survivors — fatal when the skipped
+        # object's ADDED event is the only thing that would ever reconcile it.
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        meta = {"resourceVersion": str(self.store.current_rv())
+                if hasattr(self.store, "current_rv") else "0"}
+        limit = int(req.query.get("limit", "0") or 0)
+        cont = req.query.get("continue", "")
+        if cont:
+            cns, _, cname = cont.partition("\x00")
+            items = [o for o in items
+                     if (o.metadata.namespace, o.metadata.name) > (cns, cname)]
+        if limit and len(items) > limit:
+            last = items[limit - 1]
+            meta["continue"] = f"{last.metadata.namespace}\x00{last.metadata.name}"
+            items = items[:limit]
         return web.json_response({
             "kind": f"{cls.KIND}List",
             "items": [o.to_dict() for o in items],
-            "metadata": {"resourceVersion": str(self.store.current_rv())
-                         if hasattr(self.store, "current_rv") else "0"}})
+            "metadata": meta})
 
     async def _watch(self, req: web.Request, cls: type) -> web.StreamResponse:
         resp = web.StreamResponse()

@@ -357,3 +357,29 @@ async def test_queued_resource_create_wire_shape_and_state():
                                        reservation="res9", node_pool="np1"))
     assert qr.state == "WAITING_FOR_RESOURCES"
     assert qr.name == "qr1" and qr.node_pool == "np1"
+
+
+@async_test
+async def test_kube_list_paginates_with_limit_continue():
+    """Every LIST is chunked (limit/continue) — the client must walk all
+    pages and the watch's initial list must too."""
+    total = 7
+    calls = []
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        limit = int(req.url.params.get("limit", "0") or 0)
+        start = int(req.url.params.get("continue", "0") or 0)
+        calls.append((start, limit))
+        assert limit > 0, "client must request bounded pages"
+        items = [{"metadata": {"name": f"n{i}"}} for i in range(total)]
+        page = items[start:start + limit]
+        meta = {"resourceVersion": "42"}
+        if start + limit < total:
+            meta["continue"] = str(start + limit)
+        return httpx.Response(200, json={"items": page, "metadata": meta})
+
+    c = make_kube_client(handler)
+    c.LIST_PAGE_SIZE = 3
+    items = await c.list(NodeClaim)
+    assert sorted(o.metadata.name for o in items) == [f"n{i}" for i in range(total)]
+    assert calls == [(0, 3), (3, 3), (6, 3)]
