@@ -122,6 +122,50 @@ class Pod(Object):
 
 
 @dataclass
+class LabelSelector:
+    match_labels: dict[str, str] = field(default_factory=dict)
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels.items())
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@register_kind
+@dataclass
+class PodDisruptionBudget(Object):
+    """policy/v1 PDB subset — the eviction subresource honors these
+    server-side; the in-memory client and the e2e fake apiserver evaluate
+    them so the eviction queue's 429 path (terminator/eviction.go:199-209)
+    is testable without a real cluster."""
+
+    API_VERSION: ClassVar[str] = "policy/v1"
+    KIND: ClassVar[str] = "PodDisruptionBudget"
+    NAMESPACED: ClassVar[bool] = True
+
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+
+    def disruptions_allowed(self, pods: list["Pod"]) -> int:
+        """Allowed evictions among ``pods`` (same namespace). Healthy means
+        non-terminal — the fake evaluates budgets live rather than via the
+        disruption controller's cached status."""
+        selected = [p for p in pods
+                    if self.spec.selector.matches(p.metadata.labels)]
+        healthy = sum(1 for p in selected if not p.is_terminal())
+        if self.spec.max_unavailable is not None:
+            unavailable = len(selected) - healthy
+            return max(0, self.spec.max_unavailable - unavailable)
+        if self.spec.min_available is not None:
+            return max(0, healthy - self.spec.min_available)
+        return healthy
+
+
+@dataclass
 class VolumeAttachmentSpec:
     node_name: str = ""
     attacher: str = ""

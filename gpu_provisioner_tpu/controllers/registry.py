@@ -47,7 +47,7 @@ def build_controllers(client: Client, cloudprovider,
     for fairness)."""
     lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
                                             lifecycle_options)
-    eviction = EvictionQueue(client)
+    eviction = EvictionQueue(client, recorder=recorder)
     termination = NodeTerminationController(client, cloudprovider, eviction,
                                             recorder, termination_options)
     instance_gc = InstanceGCController(client, cloudprovider, gc_options)

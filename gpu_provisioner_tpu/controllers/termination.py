@@ -22,8 +22,9 @@ from ..apis.karpenter import DRAINED, NodeClaim, VOLUMES_DETACHED
 from ..apis.serde import now, parse_time
 from ..errors import NodeClaimNotFoundError
 from ..runtime import NotFoundError, Request, Result
-from ..runtime.client import Client, patch_retry
-from ..runtime.events import Recorder
+from ..runtime.client import (Client, ConflictError, EvictionBlockedError,
+                              patch_retry)
+from ..runtime.events import NORMAL, WARNING, Recorder
 from .utils import nodeclaim_for_node
 
 log = logging.getLogger("controllers.termination")
@@ -32,20 +33,42 @@ log = logging.getLogger("controllers.termination")
 class EvictionQueue:
     """Rate-limited pod evictor (terminator/eviction.go:93-140) over the
     Client.evict seam: a plain delete in-process, the policy/v1 Eviction
-    subresource against a real apiserver (PDB-aware; 429s requeue)."""
+    subresource against a real apiserver.
 
-    def __init__(self, client: Client, qps: float = 10.0):
+    Failure handling matches the reference's rate-limiter composition
+    (eviction.go:57-58,131-136): per-pod exponential backoff from BASE_DELAY
+    capped at MAX_DELAY, layered under a global QPS limit. A pod blocked by a
+    PodDisruptionBudget gets a Warning event once the blockage persists
+    (NodeFailedToDrain analog, eviction.go:199-207) and keeps retrying at the
+    capped delay — retry-forever is deliberate: the termination controller's
+    grace-deadline escalation (_grace_expired) bounds how long a stuck drain
+    can hold the node, so the queue never has to guess when to give up.
+    Entries are keyed by (namespace, name, uid) so a replacement pod reusing
+    the name is never evicted by a stale entry (eviction.go:162-168)."""
+
+    BASE_DELAY = 0.1     # eviction.go:57 evictionQueueBaseDelay
+    MAX_DELAY = 10.0     # eviction.go:58 evictionQueueMaxDelay
+    WARN_AFTER = 3       # consecutive PDB blocks before the Warning event
+
+    def __init__(self, client: Client, qps: float = 10.0,
+                 recorder: Optional[Recorder] = None):
         self.client = client
+        self.recorder = recorder
         self.interval = 1.0 / qps
-        self._queued: set[tuple[str, str]] = set()
+        self._pods: dict[tuple[str, str, str], Pod] = {}
+        self._failures: dict[tuple[str, str, str], int] = {}
         self._q: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        self._timers: set[asyncio.Task] = set()
 
     def start(self) -> None:
         if self._task is None:
             self._task = asyncio.create_task(self._run(), name="eviction-queue")
 
     async def stop(self) -> None:
+        for t in list(self._timers):
+            t.cancel()
+        self._timers.clear()
         if self._task is not None:
             self._task.cancel()
             try:
@@ -53,25 +76,66 @@ class EvictionQueue:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # Entries parked in cancelled timers would otherwise dedup their pods
+        # out of any future enqueue; the next drain pass re-discovers them.
+        self._pods.clear()
+        self._failures.clear()
+        self._q = asyncio.Queue()
 
     def enqueue(self, pod: Pod) -> None:
-        key = (pod.metadata.namespace, pod.metadata.name)
-        if key not in self._queued:
-            self._queued.add(key)
+        key = (pod.metadata.namespace, pod.metadata.name, pod.metadata.uid)
+        if key not in self._pods:
+            self._pods[key] = pod
             self._q.put_nowait(key)
+
+    def _done(self, key: tuple[str, str, str]) -> None:
+        self._pods.pop(key, None)
+        self._failures.pop(key, None)
+
+    def _requeue_later(self, key: tuple[str, str, str]) -> None:
+        fails = self._failures[key] = self._failures.get(key, 0) + 1
+        delay = min(self.MAX_DELAY, self.BASE_DELAY * 2 ** (fails - 1))
+
+        async def timer() -> None:
+            await asyncio.sleep(delay)
+            if key in self._pods:
+                self._q.put_nowait(key)
+
+        t = asyncio.create_task(timer())
+        self._timers.add(t)
+        t.add_done_callback(self._timers.discard)
+
+    async def _warn_blocked(self, pod: Pod, err: Exception, fails: int) -> None:
+        if self.recorder is None or fails < self.WARN_AFTER:
+            return
+        await self.recorder.publish(
+            pod, WARNING, "FailedDraining",
+            f"Failed to evict pod after {fails} attempts: {err}")
 
     async def _run(self) -> None:
         while True:
-            ns, name = await self._q.get()
+            key = await self._q.get()
+            pod = self._pods.get(key)
+            if pod is None:
+                continue
+            ns, name, uid = key
             try:
-                await self.client.evict(name, ns)
-            except NotFoundError:
-                self._queued.discard((ns, name))  # already gone — allow re-use
-            except Exception as e:  # noqa: BLE001 — requeue on transient errors
+                await self.client.evict(name, ns, uid=uid)
+            except (NotFoundError, ConflictError):
+                # 404: already gone. 409: replaced by a different pod under
+                # the same name — not ours to evict (eviction.go:189-194).
+                self._done(key)
+            except EvictionBlockedError as e:
+                self._requeue_later(key)
+                await self._warn_blocked(pod, e, self._failures[key])
+            except Exception as e:  # noqa: BLE001 — backoff on transient errors
                 log.warning("evicting %s/%s: %s", ns, name, e)
-                self._q.put_nowait((ns, name))
+                self._requeue_later(key)
             else:
-                self._queued.discard((ns, name))
+                if self.recorder is not None:
+                    await self.recorder.publish(pod, NORMAL, "Evicted",
+                                                "Evicted pod")
+                self._done(key)
             await asyncio.sleep(self.interval)
 
 

@@ -13,7 +13,8 @@ environment, so the load-bearing subset is rebuilt natively on asyncio:
 """
 
 from .client import (  # noqa: F401
-    AlreadyExistsError, Client, ConflictError, InMemoryClient, NotFoundError,
+    AlreadyExistsError, Client, ConflictError, EvictionBlockedError,
+    InMemoryClient, NotFoundError,
 )
 from .controller import (  # noqa: F401
     Controller, Manager, Reconciler, Request, Result, Singleton,

@@ -36,6 +36,13 @@ class AlreadyExistsError(ClientError):
     pass
 
 
+class EvictionBlockedError(ClientError):
+    """The eviction subresource returned 429 — a PodDisruptionBudget forbids
+    the disruption right now (terminator/eviction.go:199-209). Semantic, not
+    throttling: the caller backs off and retries, it must not be eaten by the
+    transport retry loop."""
+
+
 def ignore_not_found(exc: Optional[Exception]) -> None:
     if exc is not None and not isinstance(exc, NotFoundError):
         raise exc
@@ -50,7 +57,8 @@ class Client(Protocol):
     async def update(self, obj: Object) -> Object: ...
     async def update_status(self, obj: Object) -> Object: ...
     async def delete(self, cls: type, name: str, namespace: str = "") -> None: ...
-    async def evict(self, name: str, namespace: str = "") -> None: ...
+    async def evict(self, name: str, namespace: str = "",
+                    uid: str = "") -> None: ...
     def watch(self, cls: type) -> "Watch": ...
 
 
@@ -127,10 +135,26 @@ class InMemoryClient:
     async def delete(self, cls, name, namespace=""):
         return await _translate(self.store.delete)(cls, name, namespace)
 
-    async def evict(self, name, namespace=""):
-        """Pod eviction: a plain delete in-process; the REST client posts the
-        Eviction subresource instead (terminator/eviction.go:93-140)."""
-        from ..apis.core import Pod
+    async def evict(self, name, namespace="", uid=""):
+        """Pod eviction honoring PodDisruptionBudgets, like the policy/v1
+        Eviction subresource does server-side; raises EvictionBlockedError
+        (the 429 analog) when a matching budget has no disruptions left
+        (terminator/eviction.go:199-209). ``uid`` is the delete precondition:
+        a mismatch means the pod was replaced under the same name and raises
+        ConflictError (the 409 the real subresource returns)."""
+        from ..apis.core import Pod, PodDisruptionBudget
+        pod = await _translate(self.store.get)(Pod, name, namespace)
+        if uid and pod.metadata.uid != uid:
+            raise ConflictError(
+                f"precondition failed: uid {uid} != {pod.metadata.uid}")
+        pods = await _translate(self.store.list)(Pod, None, namespace)
+        for pdb in await _translate(self.store.list)(PodDisruptionBudget,
+                                                     None, namespace):
+            if (pdb.spec.selector.matches(pod.metadata.labels)
+                    and pdb.disruptions_allowed(pods) <= 0):
+                raise EvictionBlockedError(
+                    f"evicting {namespace}/{name} violates "
+                    f"PodDisruptionBudget {pdb.metadata.name}")
         return await _translate(self.store.delete)(Pod, name, namespace)
 
     def watch(self, cls) -> Watch:

@@ -21,7 +21,7 @@ import logging
 import os
 import ssl
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 import httpx
@@ -29,7 +29,7 @@ import httpx
 from ..apis.meta import Object, object_from_manifest
 from ..transport import TransportOptions, build_http_client, request_with_retries
 from .client import (AlreadyExistsError, ClientError, ConflictError,
-                     NotFoundError)
+                     EvictionBlockedError, NotFoundError)
 from .store import ADDED, DELETED, MODIFIED, WatchEvent
 
 log = logging.getLogger("rest")
@@ -149,8 +149,13 @@ def _error_for(resp: httpx.Response, verb: str) -> ClientError:
         return NotFoundError(body)
     if resp.status_code == 409:
         # POST conflicts mean the object exists; PUT conflicts mean a stale
-        # resourceVersion — the two distinct retry paths upstream.
+        # resourceVersion — the two distinct retry paths upstream. The evict
+        # verb's 409 is a uid-precondition failure (pod replaced under the
+        # same name) and maps to ConflictError like a stale write.
         return AlreadyExistsError(body) if verb == "create" else ConflictError(body)
+    if resp.status_code == 429 and verb == "evict":
+        # A PDB verdict, not apiserver throttling (terminator/eviction.go:199).
+        return EvictionBlockedError(body)
     return ClientError(f"{verb}: HTTP {resp.status_code}: {body}")
 
 
@@ -177,9 +182,11 @@ class RestClient:
             h["Authorization"] = f"Bearer {tok}"
         return h
 
-    async def _req(self, verb: str, method: str, path: str, **kw) -> httpx.Response:
+    async def _req(self, verb: str, method: str, path: str,
+                   opts: Optional[TransportOptions] = None,
+                   **kw) -> httpx.Response:
         resp = await request_with_retries(
-            self.http, method, path, opts=self.topts,
+            self.http, method, path, opts=opts or self.topts,
             headers=await self._headers(), **kw)
         if resp.status_code >= 400:
             raise _error_for(resp, verb)
@@ -259,16 +266,27 @@ class RestClient:
     async def delete(self, cls: type, name: str, namespace: str = "") -> None:
         await self._req("delete", "DELETE", resource_path(cls, namespace, name))
 
-    async def evict(self, name: str, namespace: str = "") -> None:
+    async def evict(self, name: str, namespace: str = "",
+                    uid: str = "") -> None:
         """POST the policy/v1 Eviction subresource — honors PodDisruptionBudgets
         server-side, which a bare pod DELETE would bypass (and the chart's RBAC
-        grants pods/eviction create, not pods delete)."""
+        grants pods/eviction create, not pods delete). A 429 here is a PDB
+        verdict, not apiserver throttling — it bypasses the transport retry
+        loop and surfaces as EvictionBlockedError so the eviction queue owns
+        the backoff (terminator/eviction.go:199-209). ``uid`` becomes the
+        delete precondition so a replacement pod reusing the name is never
+        evicted by a stale queue entry (eviction.go:171-177)."""
         from ..apis.core import Pod
+        body: dict = {"apiVersion": "policy/v1", "kind": "Eviction",
+                      "metadata": {"name": name, "namespace": namespace}}
+        if uid:
+            body["deleteOptions"] = {"preconditions": {"uid": uid}}
         await self._req(
             "evict", "POST",
             resource_path(Pod, namespace, name) + "/eviction",
-            json={"apiVersion": "policy/v1", "kind": "Eviction",
-                  "metadata": {"name": name, "namespace": namespace}})
+            opts=replace(self.topts,
+                         retryable_status=self.topts.retryable_status - {429}),
+            json=body)
 
     def watch(self, cls: type) -> "RestWatch":
         return RestWatch(self, cls)

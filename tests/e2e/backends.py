@@ -33,6 +33,7 @@ def _cls_for(plural: str) -> type:
         "nodeclaims": "NodeClaim", "nodes": "Node", "pods": "Pod",
         "volumeattachments": "VolumeAttachment", "events": "Event",
         "kaitonodeclasses": "KaitoNodeClass", "leases": "Lease",
+        "poddisruptionbudgets": "PodDisruptionBudget",
     }[plural])
 
 
@@ -195,8 +196,32 @@ class FakeKubeAPIServer:
         return web.Response(status=405)
 
     async def _evict(self, req: web.Request) -> web.Response:
+        """Eviction subresource with real apiserver semantics: 429 when a
+        matching PodDisruptionBudget has no disruptions left, 409 on a uid
+        precondition mismatch, 404 when the pod is gone, 201 on success."""
+        from gpu_provisioner_tpu.apis.core import Pod, PodDisruptionBudget
         cls, ns, name = self._parse(req)
         try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 — empty body is legal
+            body = {}
+        want_uid = (body.get("deleteOptions") or {}).get(
+            "preconditions", {}).get("uid", "")
+        try:
+            pod = self.store.get(Pod, name, ns)
+            if want_uid and pod.metadata.uid != want_uid:
+                return web.Response(
+                    status=409,
+                    text=f"precondition failed: uid {want_uid} != "
+                         f"{pod.metadata.uid}")
+            pods = self.store.list(Pod, namespace=ns)
+            for pdb in self.store.list(PodDisruptionBudget, namespace=ns):
+                if (pdb.spec.selector.matches(pod.metadata.labels)
+                        and pdb.disruptions_allowed(pods) <= 0):
+                    return web.Response(
+                        status=429,
+                        text=f"Cannot evict pod as it would violate the pod's "
+                             f"disruption budget {pdb.metadata.name}")
             self.store.delete(cls, name, ns)
         except StoreNotFound as e:
             return web.Response(status=404, text=str(e))

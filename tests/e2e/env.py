@@ -201,11 +201,11 @@ class Environment:
         result = await self.eventually(check, timeout, f"{n} nodes")
         return result if result is not True else []
 
-    async def expect_gone(self, cls: type, name: str,
+    async def expect_gone(self, cls: type, name: str, namespace: str = "",
                           timeout: float = DEFAULT_TIMEOUT) -> None:
         async def check():
             try:
-                await self.client.get(cls, name)
+                await self.client.get(cls, name, namespace)
                 return None
             except NotFoundError:
                 return True

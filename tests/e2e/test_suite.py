@@ -246,3 +246,43 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
             assert args["coordinator_address"] == f"gke-kaito-{pool0}-w0:8476"
             args_seen.append(args["process_id"])
         assert sorted(args_seen) == list(range(8))
+
+
+@async_test
+async def test_pdb_blocked_drain_warns_then_completes(tmp_path):
+    """TPU extension: a PDB-blocked drain goes through the REAL eviction
+    subresource (fake apiserver answers 429), the operator surfaces a
+    Warning event, and teardown completes once the budget is lifted —
+    black-box coverage of terminator/eviction.go:199-209 semantics."""
+    from gpu_provisioner_tpu.apis.core import (Event, LabelSelector, Pod,
+                                               PodDisruptionBudget,
+                                               PodDisruptionBudgetSpec,
+                                               PodSpec)
+    async with Environment(tmp_path) as env:
+        await env.client.create(make_nodeclaim("wsp", "tpu-v5e-8"))
+        await env.expect_nodeclaim_ready("wsp")
+        (node,) = await env.expect_node_count(1)
+
+        await env.client.create(Pod(
+            metadata=ObjectMeta(name="served", namespace="default",
+                                labels={"app": "served"}),
+            spec=PodSpec(node_name=node.metadata.name)))
+        await env.client.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="served-pdb", namespace="default"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector(match_labels={"app": "served"}),
+                min_available=1)))
+
+        await env.client.delete(NodeClaim, "wsp")
+
+        async def warned():
+            evs = await env.client.list(Event, namespace="default")
+            hits = [e for e in evs if e.type == "Warning"
+                    and e.reason == "FailedDraining"
+                    and e.involved_object.name == "served"]
+            return hits or None
+        await env.eventually(warned, what="FailedDraining warning event")
+
+        await env.client.delete(PodDisruptionBudget, "served-pdb", "default")
+        await env.expect_gone(NodeClaim, "wsp")
+        await env.expect_gone(Pod, "served", "default")

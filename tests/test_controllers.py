@@ -6,7 +6,10 @@ import asyncio
 import pytest
 
 from gpu_provisioner_tpu.apis import labels as wk
-from gpu_provisioner_tpu.apis.core import Node, Pod, PodSpec
+from gpu_provisioner_tpu.apis.core import (
+    Event, LabelSelector, Node, Pod, PodDisruptionBudget,
+    PodDisruptionBudgetSpec, PodSpec,
+)
 from gpu_provisioner_tpu.apis.karpenter import (
     DRAINED, INITIALIZED, LAUNCHED, NodeClaim, REGISTERED,
 )
@@ -14,7 +17,7 @@ from gpu_provisioner_tpu.apis.meta import ObjectMeta
 from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
 from gpu_provisioner_tpu.fake import make_nodeclaim
 from gpu_provisioner_tpu.providers.gcp import APIError
-from gpu_provisioner_tpu.runtime import NotFoundError
+from gpu_provisioner_tpu.runtime import EvictionBlockedError, NotFoundError
 
 from .conftest import async_test
 
@@ -283,3 +286,87 @@ async def test_slicegroup_coordinator_repaired_after_slice0_replacement():
                 == "gke-kaito-cc-w0" for n in nodes))
             return nodes if ok else None
         await _poll(repaired, what="coordinator repointed to cc")
+
+
+# ---------------------------------------------------------------- eviction
+
+def _pdb(name="inf-pdb", app="inf", min_available=1):
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels={"app": app}),
+            min_available=min_available))
+
+
+def _workload_pod(name="inference", node="gke-kaito-ws0-w0", app="inf"):
+    return Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                   labels={"app": app}),
+               spec=PodSpec(node_name=node))
+
+
+@async_test
+async def test_in_memory_evict_honors_pdb():
+    from gpu_provisioner_tpu.runtime import ConflictError, InMemoryClient
+    client = InMemoryClient()
+    await client.create(_workload_pod())
+    await client.create(_pdb())
+    with pytest.raises(EvictionBlockedError):
+        await client.evict("inference", "default")
+    # a stale uid precondition (pod replaced under the same name) is a 409,
+    # not an eviction — the queue drops such entries
+    await client.delete(PodDisruptionBudget, "inf-pdb", "default")
+    with pytest.raises(ConflictError):
+        await client.evict("inference", "default", uid="stale-uid")
+    # lifting the budget unblocks the same call
+    await client.evict("inference", "default")
+    with pytest.raises(NotFoundError):
+        await client.get(Pod, "inference", "default")
+
+
+@async_test
+async def test_blocked_eviction_warns_then_drains_when_pdb_lifted():
+    """A PDB-blocked drain retries with backoff, surfaces a Warning event on
+    the pod once the blockage persists (eviction.go:199-207 analog), and
+    completes as soon as the budget allows."""
+    async with Env() as env:
+        await env.client.create(make_nodeclaim("ws0"))
+        await env.wait_ready("ws0")
+        await env.client.create(_workload_pod())
+        await env.client.create(_pdb())
+        await env.client.delete(NodeClaim, "ws0")
+
+        async def warned():
+            evs = await env.client.list(Event, namespace="default")
+            hits = [e for e in evs if e.type == "Warning"
+                    and e.reason == "FailedDraining"
+                    and e.involved_object.name == "inference"]
+            return hits or None
+        await _poll(warned, timeout=15.0, what="FailedDraining warning")
+
+        await env.client.delete(PodDisruptionBudget, "inf-pdb", "default")
+        await env.wait_gone("ws0", timeout=15.0)
+        with pytest.raises(NotFoundError):
+            await env.client.get(Pod, "inference", "default")
+        assert env.cloud.nodepools.pools == {}
+
+
+@async_test
+async def test_grace_deadline_escalates_past_blocked_eviction():
+    """A permanently PDB-blocked pod cannot hold the node hostage: once the
+    NodeClaim's termination-grace deadline passes, drain is abandoned and the
+    instance is torn down anyway (terminator grace escalation)."""
+    async with Env() as env:
+        nc = make_nodeclaim("ws0")
+        nc.spec.termination_grace_period = "0s"
+        await env.client.create(nc)
+        await env.wait_ready("ws0")
+        await env.client.create(_workload_pod())
+        await env.client.create(_pdb())
+        await env.client.delete(NodeClaim, "ws0")
+        await env.wait_gone("ws0", timeout=15.0)
+        # instance + claim gone; the blocked pod survives (it was never
+        # evictable) — K8s pod GC owns it once its node is gone
+        assert env.cloud.nodepools.pools == {}
+        assert await env.client.list(Node) == []
+        got = await env.client.get(Pod, "inference", "default")
+        assert got.metadata.name == "inference"

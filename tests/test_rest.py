@@ -20,7 +20,9 @@ from gpu_provisioner_tpu.providers.gcp import (APIError, NodePool,
 from gpu_provisioner_tpu.providers.rest import (CloudTPUQueuedResourcesClient,
                                                 GKENodePoolsClient)
 from gpu_provisioner_tpu.runtime.client import (AlreadyExistsError,
-                                                ConflictError, NotFoundError)
+                                                ConflictError,
+                                                EvictionBlockedError,
+                                                NotFoundError)
 from gpu_provisioner_tpu.runtime.rest import (KubeConnection, RestClient,
                                               resource_path)
 from gpu_provisioner_tpu.runtime.store import ADDED, MODIFIED
@@ -383,3 +385,21 @@ async def test_kube_list_paginates_with_limit_continue():
     items = await c.list(NodeClaim)
     assert sorted(o.metadata.name for o in items) == [f"n{i}" for i in range(total)]
     assert calls == [(0, 3), (3, 3), (6, 3)]
+
+
+@async_test
+async def test_evict_429_maps_to_blocked_without_transport_retry():
+    """A 429 from the eviction subresource is a PDB verdict: it must surface
+    as EvictionBlockedError on the FIRST response (no transport retry — the
+    eviction queue owns the backoff), while other verbs still retry 429s."""
+    calls = {"evict": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        assert req.url.path.endswith("/pods/p/eviction")
+        calls["evict"] += 1
+        return httpx.Response(429, text="disruption budget violated")
+
+    client = make_kube_client(handler)
+    with pytest.raises(EvictionBlockedError):
+        await client.evict("p", "ns1")
+    assert calls["evict"] == 1
