@@ -1,0 +1,106 @@
+"""Profiling endpoints: the pprof-parity subsystem (V9).
+
+The reference exposes Go's pprof suite on the metrics server behind
+``EnableProfiling`` (vendor/.../operator/operator.go:185-200): heap, CPU,
+goroutine, block. The Python-native equivalents here:
+
+- heap     → ``tracemalloc`` snapshot, top allocation sites by file:line
+             (started lazily on first hit so steady-state runs pay nothing)
+- profile  → a sampling CPU profiler: a short-lived background thread walks
+             ``sys._current_frames()`` at the sampling rate — wall-clock
+             sampling like pprof's CPU profile, emitted in collapsed-stack
+             format (one ``frame;frame;frame count`` line per distinct
+             stack) so it feeds straight into flamegraph tools. Sampling
+             must happen off the event-loop thread: a coroutine can only
+             ever observe its own frame on its own thread, so an in-loop
+             sampler would show nothing but itself.
+- tasks    → asyncio task dump with stacks (the goroutine-dump analog;
+             wired in server.py)
+
+Sampling instead of tracing (cProfile) keeps the overhead proportional to
+the sampling rate, not to the code under observation — safe to hit on a
+live controller, which is the whole point of the reference's pprof wiring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import linecache
+import sys
+import threading
+import time
+import tracemalloc
+from collections import Counter
+
+HEAP_TOP = 30
+DEFAULT_SECONDS = 5.0
+MAX_SECONDS = 60.0
+DEFAULT_HZ = 100.0
+
+
+def heap_snapshot(top: int = HEAP_TOP) -> str:
+    """Top allocation sites by retained size. Starts tracemalloc on first
+    call — the snapshot covers allocations from that point on, which matches
+    how operators use it (hit once to arm, hit again to inspect growth)."""
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("tracemalloc armed; allocations are now tracked.\n"
+                "Hit this endpoint again to see a snapshot.\n")
+    snap = tracemalloc.take_snapshot().filter_traces([
+        tracemalloc.Filter(False, tracemalloc.__file__),
+        tracemalloc.Filter(False, linecache.__file__),
+    ])
+    stats = snap.statistics("lineno")
+    total = sum(s.size for s in stats)
+    lines = [f"heap: {len(stats)} allocation sites, {total / 1024:.1f} KiB traced",
+             ""]
+    for s in stats[:top]:
+        frame = s.traceback[0]
+        src = linecache.getline(frame.filename, frame.lineno).strip()
+        lines.append(f"{s.size / 1024:9.1f} KiB  {s.count:7d} blocks  "
+                     f"{frame.filename}:{frame.lineno}")
+        if src:
+            lines.append(f"{'':>12}  {src}")
+    return "\n".join(lines) + "\n"
+
+
+def _sample(seconds: float, hz: float,
+            stacks: Counter[tuple[str, ...]]) -> int:
+    """Runs on a worker thread: periodically snapshot every OTHER thread's
+    Python stack (the event-loop thread included — it shows whatever
+    reconcile/serialization work holds the GIL at each tick)."""
+    me = threading.get_ident()
+    interval = 1.0 / max(hz, 1.0)
+    deadline = time.monotonic() + seconds
+    samples = 0
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno})")
+                f = f.f_back
+            stacks[tuple(reversed(stack))] += 1
+            samples += 1
+        time.sleep(interval)
+    return samples
+
+
+async def cpu_profile(seconds: float = DEFAULT_SECONDS,
+                      hz: float = DEFAULT_HZ) -> str:
+    """Sample all threads for ``seconds`` at ``hz`` and collapse identical
+    stacks. The event loop keeps serving while the sampler thread runs."""
+    seconds = min(max(seconds, 0.1), MAX_SECONDS)
+    stacks: Counter[tuple[str, ...]] = Counter()
+    samples = await asyncio.get_running_loop().run_in_executor(
+        None, _sample, seconds, hz, stacks)
+    lines = [f"# cpu profile: {samples} samples over {seconds:.1f}s "
+             f"@ {hz:.0f} Hz (collapsed-stack format)"]
+    for stack, count in stacks.most_common():
+        lines.append(f"{';'.join(stack)} {count}")
+    return "\n".join(lines) + "\n"

@@ -54,6 +54,8 @@ def build_apps(manager: Manager, enable_profiling: bool = False):
     metrics.router.add_get("/metrics", metrics_handler)
 
     if enable_profiling:
+        from . import profiling
+
         async def tasks_handler(_req):
             lines = []
             for t in asyncio.all_tasks():
@@ -62,7 +64,25 @@ def build_apps(manager: Manager, enable_profiling: bool = False):
                     lines.append("".join(traceback.format_stack(frame, limit=1)))
             return web.Response(text="\n".join(lines))
 
+        async def heap_handler(_req):
+            return web.Response(text=profiling.heap_snapshot())
+
+        async def profile_handler(req):
+            try:
+                seconds = float(req.query.get(
+                    "seconds", profiling.DEFAULT_SECONDS))
+                hz = float(req.query.get("hz", profiling.DEFAULT_HZ))
+            except ValueError:
+                return web.Response(status=400, text="bad seconds/hz")
+            return web.Response(text=await profiling.cpu_profile(seconds, hz))
+
+        # /debug/pprof/* mirrors the reference's route names
+        # (operator.go:185-200); /debug/tasks is the goroutine-dump analog
+        # kept at its original path.
         metrics.router.add_get("/debug/tasks", tasks_handler)
+        metrics.router.add_get("/debug/pprof/goroutine", tasks_handler)
+        metrics.router.add_get("/debug/pprof/heap", heap_handler)
+        metrics.router.add_get("/debug/pprof/profile", profile_handler)
 
     health = web.Application()
 

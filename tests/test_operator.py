@@ -136,3 +136,51 @@ async def test_metrics_and_health_servers():
         assert "karpenter_cloudprovider_duration_seconds" in text
         r = await mc.get("/debug/tasks")
         assert r.status == 200
+
+
+@async_test
+async def test_profiling_endpoints():
+    """pprof parity (operator.go:185-200): heap snapshot arms then reports;
+    CPU profile samples off-thread and emits collapsed stacks."""
+    import threading
+    import time as _time
+
+    from aiohttp.test_utils import TestClient, TestServer
+    from gpu_provisioner_tpu.operator.server import build_apps
+    from gpu_provisioner_tpu.runtime import InMemoryClient, Manager
+
+    mgr = Manager(InMemoryClient())
+    metrics_app, _health_app = build_apps(mgr, enable_profiling=True)
+
+    async with TestClient(TestServer(metrics_app)) as mc:
+        # heap: first hit arms tracemalloc, second reports sites
+        r = await mc.get("/debug/pprof/heap")
+        assert r.status == 200
+        _garbage = [bytearray(4096) for _ in range(64)]
+        r = await mc.get("/debug/pprof/heap")
+        body = await r.text()
+        assert "KiB" in body and "blocks" in body
+
+        # profile: run a busy worker thread so the sampler has something
+        # unmistakable to catch
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(i * i for i in range(1000))
+                _time.sleep(0)
+
+        t = threading.Thread(target=spin, name="spinner", daemon=True)
+        t.start()
+        try:
+            r = await mc.get("/debug/pprof/profile?seconds=0.5&hz=200")
+            prof = await r.text()
+        finally:
+            stop.set()
+            t.join(timeout=2)
+        assert prof.startswith("# cpu profile:")
+        assert "spin" in prof  # the worker's frames were sampled
+
+        # goroutine-dump alias serves the task dump
+        r = await mc.get("/debug/pprof/goroutine")
+        assert r.status == 200
