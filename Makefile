@@ -27,6 +27,13 @@ unit-test: ## Unit tests (reference Makefile:171-175)
 e2etests: ## e2e suite: real operator subprocess vs HTTP fakes (Makefile:177-187)
 	$(PY) -m pytest tests/e2e -q
 
+.PHONY: e2etests-real
+e2etests-real: ## Same specs against a live cluster (suite_test.go:34-45 mode).
+	## Prereqs: operator deployed (make helm-install), KUBECONFIG pointing at
+	## the cluster, PROJECT_ID/LOCATION/CLUSTER_NAME set, ADC available.
+	E2E_TARGET=real PROJECT_ID=$(PROJECT_ID) LOCATION=$(LOCATION) \
+	  CLUSTER_NAME=$(CLUSTER_NAME) $(PY) -m pytest tests/e2e -q -p no:cacheprovider
+
 .PHONY: test
 test: ## Everything
 	$(PY) -m pytest tests/ -q

@@ -68,7 +68,10 @@ TPU_COORDINATOR_LABEL = "tpu.kaito.sh/coordinator"     # worker 0 of slice 0
 TPU_TAINT = "google.com/tpu"
 
 # e2e test-discovery label (reference: vendor/.../pkg/test/metadata.go:33).
+# Builders stamp DISCOVERY_VALUE; real-cluster e2e teardown sweeps by it —
+# the two MUST stay one constant or cleanup silently matches nothing.
 DISCOVERY_LABEL = "testing/cluster"
+DISCOVERY_VALUE = "tpu-provisioner-e2e"
 
 # Domains whose labels are controller-managed and synced NodeClaim → Node
 # (reference: registration.go:120-147 syncs all nodeclaim labels).

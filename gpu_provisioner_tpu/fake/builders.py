@@ -19,6 +19,10 @@ def make_nodeclaim(name: str = "ws0", shape: str = "tpu-v5e-8",
     meta_labels = {
         wk.KAITO_WORKSPACE_LABEL: workspace,
         wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,
+        # every built claim is discoverable for e2e cleanup, like the
+        # reference's test.NodeClaim builder stamping DiscoveryLabel
+        # (vendor/.../pkg/test/nodeclaim.go:32, metadata.go:33)
+        wk.DISCOVERY_LABEL: wk.DISCOVERY_VALUE,
         **(labels or {}),
     }
     requests = {wk.TPU_RESOURCE_NAME: "1"}

@@ -51,6 +51,9 @@ class _AuthedREST:
                                  retryable_status=GCP_RETRYABLE_STATUS)
         self.http = http or build_http_client(self.topts)
 
+    async def aclose(self) -> None:
+        await self.http.aclose()
+
     async def req(self, method: str, path: str, **kw) -> dict:
         headers = {"Authorization": f"Bearer {await self.cred.token()}",
                    "Content-Type": "application/json"}
@@ -110,6 +113,9 @@ class GKENodePoolsClient:
         self.parent = (f"/projects/{project}/locations/{location}"
                        f"/clusters/{cluster}")
         self.ops_path = f"/projects/{project}/locations/{location}/operations"
+
+    async def aclose(self) -> None:
+        await self.rest.aclose()
 
     # --- seam ↔ wire translation ------------------------------------------
 

@@ -71,6 +71,12 @@ class KubeConnection:
     client_cert: str = ""      # PEM path (kubeconfig client-certificate)
     client_key: str = ""
     namespace: str = "default"
+    # client-go exec credential plugin (the auth a `gcloud container clusters
+    # get-credentials` kubeconfig uses: gke-gcloud-auth-plugin). Run at most
+    # once per TOKEN_REREAD_SECONDS; the returned ExecCredential token is
+    # cached like the projected-token path.
+    exec_argv: tuple = ()
+    exec_env: tuple = ()       # extra (name, value) pairs from the kubeconfig
 
     _cached_token: str = field(default="", repr=False)
     _token_at: float = field(default=0.0, repr=False)
@@ -112,6 +118,12 @@ class KubeConnection:
                 return f.name
             return ""
 
+        exec_cfg = user.get("exec") or {}
+        exec_argv = tuple([exec_cfg["command"], *exec_cfg.get("args", [])]
+                          if exec_cfg else [])
+        exec_env = tuple((e["name"], e["value"])
+                         for e in exec_cfg.get("env") or [])
+
         return cls(
             server=cluster["server"],
             ca_file=materialize("certificate-authority-data",
@@ -120,16 +132,33 @@ class KubeConnection:
             client_cert=materialize("client-certificate-data",
                                     "client-certificate", user),
             client_key=materialize("client-key-data", "client-key", user),
+            exec_argv=exec_argv,
+            exec_env=exec_env,
             namespace=ctx.get("namespace", "default"))
+
+    def _exec_token(self) -> str:
+        """Run the kubeconfig's exec credential plugin and pull the bearer
+        token out of the ExecCredential it prints (client-go's exec auth)."""
+        import subprocess
+        env = dict(os.environ, **dict(self.exec_env))
+        out = subprocess.run(list(self.exec_argv), env=env, check=True,
+                             capture_output=True, timeout=60).stdout
+        return json.loads(out).get("status", {}).get("token", "")
+
+    def _stale(self, loop_time: float) -> bool:
+        return (not self._cached_token
+                or loop_time - self._token_at > TOKEN_REREAD_SECONDS)
 
     def bearer(self, loop_time: float) -> str:
         if self.token:
             return self.token
-        if not self.token_file:
+        if not self.token_file and not self.exec_argv:
             return ""
-        if (not self._cached_token
-                or loop_time - self._token_at > TOKEN_REREAD_SECONDS):
-            self._cached_token = open(self.token_file).read().strip()
+        if self._stale(loop_time):
+            if self.exec_argv:
+                self._cached_token = self._exec_token()
+            else:
+                self._cached_token = open(self.token_file).read().strip()
             self._token_at = loop_time
         return self._cached_token
 
@@ -169,6 +198,7 @@ class RestClient:
         self.topts = transport or TransportOptions()
         self.http = http or conn.build_http(self.topts)
         self._indexes: dict[tuple[type, str], object] = {}
+        self._token_lock = asyncio.Lock()
 
     # index emulation: same registration surface as Store.add_index; REST has
     # no server-side field indexes for these, so list filters client-side.
@@ -177,7 +207,18 @@ class RestClient:
 
     async def _headers(self) -> dict:
         h = {"Content-Type": "application/json"}
-        tok = self.conn.bearer(asyncio.get_event_loop().time())
+        t = asyncio.get_event_loop().time()
+        if self.conn.exec_argv and self.conn._stale(t):
+            # The exec plugin (e.g. gke-gcloud-auth-plugin) can take seconds —
+            # refresh off-loop, one refresher at a time so a burst of requests
+            # doesn't spawn a plugin per request.
+            async with self._token_lock:
+                if self.conn._stale(t):
+                    tok = await asyncio.to_thread(self.conn.bearer, t)
+                else:
+                    tok = self.conn.bearer(t)
+        else:
+            tok = self.conn.bearer(t)
         if tok:
             h["Authorization"] = f"Bearer {tok}"
         return h

@@ -1,4 +1,4 @@
-"""e2e Environment: real operator process against the HTTP fakes.
+"""e2e Environment: the same specs against HTTP fakes or a real cluster.
 
 The analog of the reference harness's Environment + Monitor + expectations
 (test/e2e/pkg/environment/common/environment.go:56-88, monitor.go:32-100,
@@ -6,6 +6,17 @@ expectation.go:45-415): spins up the apiserver/GCP facades, launches the
 operator as a SUBPROCESS (black box — real flags, env, HTTP, signals), and
 exposes an expectation surface with Eventually semantics plus controller log
 dump on failure (expectation.go:375's printControllerLogs analog).
+
+``E2E_TARGET=real`` retargets the suite at a live cluster, mirroring the
+reference's real-AKS mode (suite_test.go:34-45): the kube client comes from
+``KUBECONFIG`` (token, client-cert, or exec-plugin auth — a stock
+``gcloud container clusters get-credentials`` kubeconfig works), node-pool
+assertions go through the production GKE client (PROJECT_ID / LOCATION /
+CLUSTER_NAME env, ADC credentials), the operator is expected to already be
+deployed (helm chart), and teardown deletes every NodeClaim carrying the
+test DISCOVERY_LABEL in parallel (setup.go:58-89 analog). Specs that poke
+fake-cloud seams (fault injection, direct store access) are marked
+``fake_only`` and skip on the real target.
 """
 
 from __future__ import annotations
@@ -18,8 +29,10 @@ import time
 from typing import Optional
 
 import httpx
+import pytest
 import yaml
 
+from gpu_provisioner_tpu.apis import labels as wk
 from gpu_provisioner_tpu.apis.core import Node
 from gpu_provisioner_tpu.apis.karpenter import NodeClaim
 from gpu_provisioner_tpu.apis.meta import CONDITION_READY
@@ -31,10 +44,18 @@ from gpu_provisioner_tpu.transport import TransportOptions
 
 from .backends import FakeGCPServer, FakeKubeAPIServer
 
+E2E_TARGET = os.environ.get("E2E_TARGET", "fake")
+IS_REAL = E2E_TARGET == "real"
+
+fake_only = pytest.mark.skipif(
+    IS_REAL, reason="drives fake-cloud seams (fault injection, direct store "
+                    "access, operator subprocess) with no real-cluster analog")
+
 # The reference defaults Eventually to 10 min on real AKS
 # (environment.go:67); the fake cloud answers in ms, but specs share a loaded
 # CI box with JAX compiles — generous timeouts keep them deterministic.
-DEFAULT_TIMEOUT = 90.0
+DEFAULT_TIMEOUT = float(os.environ.get("E2E_TIMEOUT_SECONDS",
+                                       "600" if IS_REAL else "90"))
 
 
 def _free_port() -> int:
@@ -52,20 +73,26 @@ class Environment:
         self.leak_grace = leak_grace
         self.extra_env = extra_env or {}
         self.cloud_kwargs = cloud_kwargs or {}
+        self.real = IS_REAL
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.client: Optional[RestClient] = None
+        self.nodepools = None  # node-pool assertion seam, both targets
+        self._log_task = None
+        self.logs: list[str] = []
+        if self.real:
+            return
         self.backing = InMemoryClient()
         self.cloud = FakeCloud(self.backing, create_latency=0.1,
                                delete_latency=0.05, node_ready_delay=0.05,
                                **self.cloud_kwargs)
         self.kube_server = FakeKubeAPIServer(self.backing)
         self.gcp_server = FakeGCPServer(self.cloud)
-        self.proc: Optional[asyncio.subprocess.Process] = None
-        self.client: Optional[RestClient] = None
-        self._log_task = None
-        self.logs: list[str] = []
         self.health_port = _free_port()
         self.metrics_port = _free_port()
 
     async def __aenter__(self) -> "Environment":
+        if self.real:
+            return await self._enter_real()
         kube_url = await self.kube_server.start()
         gcp_url = await self.gcp_server.start()
 
@@ -113,8 +140,54 @@ class Environment:
             KubeConnection(server=kube_url, token="e2e-token"),
             transport=TransportOptions(max_retries=3, backoff_base=0.05,
                                        backoff_cap=0.2))
+        self.nodepools = self.cloud.nodepools
         await self._await_ready()
         return self
+
+    async def _enter_real(self) -> "Environment":
+        """Target a live cluster: kubeconfig client + production GKE client;
+        the operator must already be running in-cluster (helm chart)."""
+        from gpu_provisioner_tpu.auth.config import build_config
+        from gpu_provisioner_tpu.auth.credentials import new_credential
+        from gpu_provisioner_tpu.providers import rest as gcprest
+
+        self.client = RestClient(KubeConnection.from_kubeconfig())
+        cfg = build_config()
+        self.nodepools = gcprest.GKENodePoolsClient(
+            new_credential(cfg), cfg.project_id, cfg.location,
+            cfg.cluster_name,
+            endpoint=cfg.gke_api_endpoint or gcprest.GKE_ENDPOINT)
+        # readiness gate: apiserver reachable + NodeClaim CRD served (the
+        # reference's readyz checks CRD presence, operator.go:207-224)
+        await self.client.list(NodeClaim)
+        return self
+
+    async def _cleanup_real(self) -> None:
+        """Delete every test-labeled object in parallel and wait for the
+        controllers to unwind the claims (setup.go:58-89's 50-worker
+        cleanup)."""
+        from gpu_provisioner_tpu.apis.kaito import KaitoNodeClass
+
+        selector = {wk.DISCOVERY_LABEL: wk.DISCOVERY_VALUE}
+
+        async def _delete(cls: type, name: str) -> None:
+            try:
+                await self.client.delete(cls, name)
+            except NotFoundError:
+                pass
+
+        deletes = [(NodeClaim, c.metadata.name)
+                   for c in await self.client.list(NodeClaim, labels=selector)]
+        deletes += [(KaitoNodeClass, k.metadata.name)
+                    for k in await self.client.list(KaitoNodeClass,
+                                                    labels=selector)]
+        await asyncio.gather(*(_delete(cls, name) for cls, name in deletes))
+
+        async def all_gone():
+            left = await self.client.list(NodeClaim, labels=selector)
+            return not left or None
+        await self.eventually(all_gone, timeout=DEFAULT_TIMEOUT,
+                              what="e2e NodeClaims cleaned up")
 
     async def _pump_logs(self) -> None:
         assert self.proc and self.proc.stdout
@@ -141,6 +214,15 @@ class Environment:
         raise TimeoutError("operator /readyz never became 200")
 
     async def __aexit__(self, *exc) -> None:
+        if self.real:
+            try:
+                await self._cleanup_real()
+            finally:
+                if self.client:
+                    await self.client.aclose()
+                if self.nodepools is not None:
+                    await self.nodepools.aclose()
+            return
         if self.proc and self.proc.returncode is None:
             self.proc.terminate()
             try:
@@ -158,6 +240,8 @@ class Environment:
             self.dump_logs()
 
     def dump_logs(self) -> None:
+        if self.real:
+            return  # operator logs live in the cluster (kubectl logs)
         print("\n--- operator logs " + "-" * 50)
         for line in self.logs[-200:]:
             print(line)
@@ -191,10 +275,25 @@ class Environment:
         return await self.eventually(check, timeout,
                                      f"NodeClaim {name} Ready")
 
+    async def kaito_pools(self) -> list:
+        """Kaito-owned node pools only (agentPoolIsOwnedByKaito analog,
+        reference instance.go:387-400) — a real cluster also has system
+        pools."""
+        return [p for p in await self.nodepools.list()
+                if (p.config.labels or {}).get(wk.NODEPOOL_LABEL)
+                == wk.KAITO_NODEPOOL_NAME]
+
+    async def _managed_nodes(self) -> list[Node]:
+        """Provisioner-managed nodes only — a real cluster also has system
+        pools the specs must not count (the reference scopes its Monitor the
+        same way via its nodepool labels)."""
+        return [n for n in await self.client.list(Node)
+                if wk.TPU_SLICE_ID_LABEL in n.metadata.labels]
+
     async def expect_node_count(self, n: int,
                                 timeout: float = DEFAULT_TIMEOUT) -> list[Node]:
         async def check():
-            nodes = await self.client.list(Node)
+            nodes = await self._managed_nodes()
             # `or True` so expecting zero nodes doesn't return a falsy []
             return (nodes or True) if len(nodes) == n else None
 
@@ -223,11 +322,12 @@ class Monitor:
 
     async def reset(self) -> None:
         self._baseline = {n.metadata.name
-                          for n in await self.env.client.list(Node)}
+                          for n in await self.env._managed_nodes()}
         self._seen = set(self._baseline)
 
     async def _observe(self) -> set[str]:
-        names = {n.metadata.name for n in await self.env.client.list(Node)}
+        names = {n.metadata.name
+                 for n in await self.env._managed_nodes()}
         self._seen |= names
         return names
 

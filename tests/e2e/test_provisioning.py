@@ -43,4 +43,4 @@ async def test_provision_and_teardown_multihost(tmp_path):
         await env.expect_gone(NodeClaim, "ws0")
         await env.expect_node_count(0)
         assert await mon.deleted_count() == 4
-        assert not await env.cloud.nodepools.list()
+        assert not await env.kaito_pools()

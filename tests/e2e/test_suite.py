@@ -27,7 +27,7 @@ from gpu_provisioner_tpu.providers.instance import ts_label
 from gpu_provisioner_tpu.apis.core import Node
 
 from ..conftest import async_test_long as async_test
-from .env import Environment
+from .env import Environment, fake_only
 
 pytestmark = pytest.mark.e2e
 
@@ -74,16 +74,21 @@ async def test_teardown_via_node_delete(tmp_path):
         await env.expect_node_count(0)
 
         async def pools_gone():
-            return not await env.cloud.nodepools.list() or None
+            return not await env.kaito_pools() or None
         await env.eventually(pools_gone, what="node pools cleaned up")
 
 
 @async_test
 async def test_nodeclass_provisioning(tmp_path):
     """suite_test.go:321 — NodeClassRef alone (no kaito labels) qualifies."""
+    from gpu_provisioner_tpu.runtime import AlreadyExistsError
     async with Environment(tmp_path) as env:
-        await env.client.create(KaitoNodeClass(
-            metadata=ObjectMeta(name="default")))
+        try:
+            await env.client.create(KaitoNodeClass(metadata=ObjectMeta(
+                name="default",
+                labels={wk.DISCOVERY_LABEL: wk.DISCOVERY_VALUE})))
+        except AlreadyExistsError:
+            pass  # left by a previous real-target run mid-teardown
         nc = make_nodeclaim("klass0", "tpu-v5e-8")
         del nc.metadata.labels[wk.KAITO_WORKSPACE_LABEL]
         assert nc.spec.node_class_ref.kind == "KaitoNodeClass"
@@ -104,8 +109,8 @@ async def test_foreign_nodeclass_is_ignored(tmp_path):
         await asyncio.sleep(3)  # several reconcile periods
         fresh = await env.client.get(NodeClaim, "foreign0")
         assert not fresh.status_conditions.is_true(LAUNCHED)
-        assert not await env.cloud.nodepools.list()
-        assert await env.client.list(Node) == []
+        assert not await env.kaito_pools()
+        assert await env._managed_nodes() == []
 
 
 @async_test
@@ -117,10 +122,11 @@ async def test_image_family_annotation(tmp_path):
             "img0", "tpu-v5e-8",
             annotations={wk.KAITO_NODE_IMAGE_FAMILY_ANNOTATION: "ubuntu"}))
         await env.expect_nodeclaim_ready("img0")
-        pool = await env.cloud.nodepools.get("img0")
+        pool = await env.nodepools.get("img0")
         assert pool.config.image_type == "UBUNTU_CONTAINERD"
 
 
+@fake_only
 @async_test
 async def test_stockout_deletes_claim(tmp_path):
     """No reference analog on AKS; BASELINE hard part 2 — RESOURCE_EXHAUSTED
@@ -135,6 +141,7 @@ async def test_stockout_deletes_claim(tmp_path):
         assert not await env.cloud.nodepools.list()
 
 
+@fake_only
 @async_test
 async def test_gc_deletes_leaked_instance(tmp_path):
     """pkg/controllers/instance/garbagecollection readme scenario: a slice
@@ -160,6 +167,7 @@ async def test_gc_deletes_leaked_instance(tmp_path):
         await env.expect_node_count(0)
 
 
+@fake_only
 @async_test
 async def test_node_repair_replaces_unhealthy(tmp_path):
     """§3.5 — NodeReady=False past toleration deletes the NodeClaim."""
@@ -180,6 +188,7 @@ async def test_node_repair_replaces_unhealthy(tmp_path):
         await env.expect_gone(NodeClaim, "sick0")
 
 
+@fake_only
 @async_test
 async def test_operator_with_leader_election(tmp_path):
     """Multi-replica readiness: election ON (reference defaults it off,
@@ -197,6 +206,7 @@ async def test_operator_with_leader_election(tmp_path):
         await env.expect_nodeclaim_ready("led0")
 
 
+@fake_only
 @async_test
 async def test_multislice_group_provisions_n_slices(tmp_path):
     """BASELINE config 5: 4× v5e-16 NodeClaims in one DCN slice group.
@@ -248,6 +258,7 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
         assert sorted(args_seen) == list(range(8))
 
 
+@fake_only
 @async_test
 async def test_pdb_blocked_drain_warns_then_completes(tmp_path):
     """TPU extension: a PDB-blocked drain goes through the REAL eviction
@@ -286,3 +297,55 @@ async def test_pdb_blocked_drain_warns_then_completes(tmp_path):
         await env.client.delete(PodDisruptionBudget, "served-pdb", "default")
         await env.expect_gone(NodeClaim, "wsp")
         await env.expect_gone(Pod, "served", "default")
+
+
+@async_test
+async def test_real_mode_plumbing_against_stand_in_cluster(tmp_path, monkeypatch):
+    """E2E_TARGET=real wiring, proven without a live cluster: Environment
+    builds its client from KUBECONFIG (token auth here; exec-plugin covered
+    in test_rest), reaches node pools through the production GKE client, and
+    cleanup deletes discovery-labeled NodeClaims in parallel. The fakes stand
+    in for the cluster; on a real one the same code path runs unchanged."""
+    import yaml as _yaml
+
+    from gpu_provisioner_tpu.fake.cloud import FakeCloud
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+
+    from . import env as env_module
+    from .backends import FakeGCPServer, FakeKubeAPIServer
+
+    backing = InMemoryClient()
+    cloud = FakeCloud(backing)
+    kube_server = FakeKubeAPIServer(backing)
+    gcp_server = FakeGCPServer(cloud)
+    kube_url = await kube_server.start()
+    gcp_url = await gcp_server.start()
+    try:
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(_yaml.safe_dump({
+            "current-context": "real",
+            "contexts": [{"name": "real",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": kube_url}}],
+            "users": [{"name": "u", "user": {"token": "real-token"}}],
+        }))
+        for k, v in {"KUBECONFIG": str(kubeconfig),
+                     "PROJECT_ID": "real-proj", "LOCATION": "us-central2-b",
+                     "CLUSTER_NAME": "kaito",
+                     "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "real-token",
+                     "GKE_API_ENDPOINT": f"{gcp_url}/v1",
+                     "TPU_API_ENDPOINT": f"{gcp_url}/v2"}.items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(env_module, "IS_REAL", True)
+
+        async with Environment(tmp_path) as env:
+            assert env.real and env.proc is None  # no subprocess in real mode
+            assert await env.kaito_pools() == []
+            # a discovery-labeled claim left behind by a spec...
+            await env.client.create(make_nodeclaim("straggler", "tpu-v5e-8"))
+            assert len(await env.client.list(NodeClaim)) == 1
+        # ...is swept by the exit cleanup (setup.go:58-89 analog)
+        assert await backing.list(NodeClaim) == []
+    finally:
+        await gcp_server.stop()
+        await kube_server.stop()

@@ -403,3 +403,41 @@ async def test_evict_429_maps_to_blocked_without_transport_retry():
     with pytest.raises(EvictionBlockedError):
         await client.evict("p", "ns1")
     assert calls["evict"] == 1
+
+
+def test_kubeconfig_exec_plugin_auth(tmp_path):
+    """A gcloud-style kubeconfig authenticates via an exec credential plugin
+    (client-go exec auth): the plugin's ExecCredential token becomes the
+    bearer, cached like the projected-token path."""
+    counter = tmp_path / "invocations"
+    plugin = tmp_path / "fake-auth-plugin"
+    plugin.write_text(
+        "#!/bin/sh\n"
+        f'echo x >> "{counter}"\n'
+        f'N=$(wc -l < "{counter}" | tr -d " ")\n'
+        'echo "{\\"apiVersion\\": \\"client.authentication.k8s.io/v1\\", '
+        '\\"kind\\": \\"ExecCredential\\", '
+        '\\"status\\": {\\"token\\": \\"exec-tok-$PLUGIN_SUFFIX-$N\\"}}"\n')
+    plugin.chmod(0o755)
+    kc = tmp_path / "kubeconfig"
+    kc.write_text(json.dumps({
+        "current-context": "gke",
+        "contexts": [{"name": "gke",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": "https://k8s.test"}}],
+        "users": [{"name": "u", "user": {"exec": {
+            "apiVersion": "client.authentication.k8s.io/v1",
+            "command": str(plugin),
+            "args": [],
+            "env": [{"name": "PLUGIN_SUFFIX", "value": "42"}],
+        }}}],
+    }))
+    conn = KubeConnection.from_kubeconfig(str(kc))
+    assert conn.exec_argv == (str(plugin),)
+    assert conn.bearer(0.0) == "exec-tok-42-1"
+    # cached — inside the reread window the plugin does NOT run again (the
+    # token embeds an invocation counter, so a re-run would change it)
+    assert conn.bearer(1.0) == "exec-tok-42-1"
+    assert counter.read_text().count("x") == 1
+    # past the window it refreshes and picks up the new credential
+    assert conn.bearer(1000.0) == "exec-tok-42-2"
