@@ -174,6 +174,7 @@ def bench_train_step(fast: bool) -> dict:
     """Full train step (forward + backward + adamw update) with the Pallas
     flash kernel + remat — the north-star workload — and its MFU."""
     import jax
+    import jax.numpy as jnp
     from gpu_provisioner_tpu.models.llama import LlamaConfig
     from gpu_provisioner_tpu.models.train import (BATCH_SPEC, make_train_state,
                                                   make_train_step)
@@ -193,7 +194,12 @@ def bench_train_step(fast: bool) -> dict:
                        attn_impl=impl, remat=True))
     B, S = (4, 512) if fast else (8, 2048)
     mesh = make_mesh(1, devices=[dev])
-    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    # Adam first moment in bf16: the ~1B model + f32 AdamW overflows a v5e
+    # chip's 16G HBM by ~0.6G; bf16 mu buys 1.7G with no step-time cost.
+    import optax
+    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh,
+                                              optimizer=opt)
     step = make_train_step(mesh, cfg, opt)
     toks = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size)
     put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
@@ -244,13 +250,19 @@ def bench_long_context(fast: bool) -> dict:
     put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
     inp, tgt = put(toks[:, :-1]), put(toks[:, 1:])
 
-    params, opt_state, loss = step(params, opt_state, inp, tgt)
-    loss.block_until_ready()
-    t0 = time.perf_counter()
-    params, opt_state, loss = step(params, opt_state, inp, tgt)
-    loss.block_until_ready()
-    float(loss)
-    return {"seq_len": S, "step_ms": (time.perf_counter() - t0) * 1e3}
+    # TWO warm steps: donation changes the arg layouts after the first call,
+    # which triggers a second compile — timing step 2 would measure it.
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, inp, tgt)
+        loss.block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, inp, tgt)
+        loss.block_until_ready()
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    return {"seq_len": S, "step_ms": best * 1e3}
 
 
 def bench_flash_op(fast: bool) -> dict:

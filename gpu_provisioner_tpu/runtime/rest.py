@@ -80,6 +80,7 @@ class KubeConnection:
 
     _cached_token: str = field(default="", repr=False)
     _token_at: float = field(default=0.0, repr=False)
+    _token_fetched: bool = field(default=False, repr=False)
 
     @classmethod
     def in_cluster(cls) -> "KubeConnection":
@@ -144,16 +145,20 @@ class KubeConnection:
         out = subprocess.run(list(self.exec_argv), env=env, check=True,
                              capture_output=True, timeout=60).stdout
         tok = json.loads(out).get("status", {}).get("token", "")
-        if not tok:
+        if not tok and not self.client_cert:
             # cert-based ExecCredentials (clientCertificateData) are not
             # supported; fail loudly rather than re-running the plugin per
-            # request and sending unauthenticated calls.
+            # request and sending unauthenticated calls. With a static
+            # client cert configured, mTLS carries the auth and an empty
+            # token is fine.
             raise ClientError(
                 f"exec plugin {self.exec_argv[0]} returned no bearer token")
         return tok
 
     def _stale(self, loop_time: float) -> bool:
-        return (not self._cached_token
+        # fetched-flag, not token truthiness: an exec plugin may validly
+        # yield no token (mTLS via client_cert) and must not re-run per call
+        return (not self._token_fetched
                 or loop_time - self._token_at > TOKEN_REREAD_SECONDS)
 
     def bearer(self, loop_time: float) -> str:
@@ -167,6 +172,7 @@ class KubeConnection:
             else:
                 self._cached_token = open(self.token_file).read().strip()
             self._token_at = loop_time
+            self._token_fetched = True
         return self._cached_token
 
     def build_http(self, opts: Optional[TransportOptions] = None) -> httpx.AsyncClient:
