@@ -196,8 +196,8 @@ def bench_train_step(fast: bool) -> dict:
     mesh = make_mesh(1, devices=[dev])
     # Adam first moment in bf16: the ~1B model + f32 AdamW overflows a v5e
     # chip's 16G HBM by ~0.6G; bf16 mu buys 1.7G with no step-time cost.
-    import optax
-    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+    from gpu_provisioner_tpu.models.train import default_optimizer
+    opt = default_optimizer(mu_dtype=jnp.bfloat16)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh,
                                               optimizer=opt)
     step = make_train_step(mesh, cfg, opt)

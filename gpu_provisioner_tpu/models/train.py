@@ -27,10 +27,11 @@ from .llama import LlamaConfig, forward, init_params, param_specs
 BATCH_SPEC = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
 
 
-def default_optimizer():
+def default_optimizer(mu_dtype=None):
     """The one default — make_train_state and make_train_step must agree or
-    opt_state layout and update rules silently diverge."""
-    return optax.adamw(3e-4, weight_decay=0.1)
+    opt_state layout and update rules silently diverge. ``mu_dtype=bfloat16``
+    halves first-moment memory for HBM-bound single-chip runs."""
+    return optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
 
 
 def make_attn_fn(mesh, impl: str = "dense") -> Callable:
@@ -40,7 +41,7 @@ def make_attn_fn(mesh, impl: str = "dense") -> Callable:
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:
         return jax.shard_map(
-            partial(ring_attention, axis_name=AXIS_SEQ),
+            partial(ring_attention, axis_name=AXIS_SEQ, impl=impl),
             mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec, check_vma=False)
     if impl == "flash":

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..parallel.ring import dense_attention
+from ..parallel.ring import dense_attention, dense_attention_with_lse
 
 NEG_INF = -1.0e30
 # Block-size sweep on v5e (batch 4-8, D=128, bf16, causal): 128×128 leaves
@@ -350,7 +350,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
@@ -362,6 +362,10 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     # [B*Hq, S, 1] like lse, for legal (1, block_q, 1) blocks.
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if g_lse is not None:
+        # lse cotangent folds straight into Δ: dS = P∘(dP − Δ + ḡ_lse)
+        # because ∂lse/∂S = P — the kernels run unchanged on Δ' = Δ − ḡ.
+        delta = delta - g_lse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
@@ -434,6 +438,53 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    B, _, Hq, _ = q.shape
+    return out, lse.reshape(B, Hq, -1)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    B, _, Hq, _ = q.shape
+    return (out, lse.reshape(B, Hq, -1)), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    g_out, g_lse = g
+    B, S, Hq, _ = q.shape
+    return _flash_bwd_impl(q, k, v, o, lse, g_out, causal, scale, block_q,
+                           block_k, interpret,
+                           g_lse=g_lse.reshape(B * Hq, S, 1))
+
+
+_flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             scale: float = None, block_q: int = None,
+                             block_k: int = None, interpret: bool = None):
+    """flash_attention that also returns the per-row logsumexp [B, Hq, S] —
+    the combination handle ring attention needs to merge partial attentions
+    across ring steps (parallel/ring.py). Differentiable in both outputs."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    block_q = _auto_block(S, block_q)
+    block_k = _auto_block(S, block_k)
+    tiles = (S % block_q == 0 and S % block_k == 0 and Hq % Hkv == 0
+             and q.shape[1] == k.shape[1])
+    if not tiles:
+        return dense_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    return _flash_lse_diff(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,

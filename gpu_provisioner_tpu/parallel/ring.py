@@ -43,13 +43,24 @@ def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
-                   scale: float | None = None):
+                   scale: float | None = None, impl: str = "dense"):
     """Exact attention with K/V rotating around ``axis_name``.
 
     Args (per-device blocks, inside shard_map):
       q: [B, Sq, Hq, D] — local query block (global seq sharded over axis)
       k, v: [B, Sk, Hkv, D] — local key/value block
     Returns [B, Sq, Hq, D] in q.dtype.
+
+    ``impl="flash"`` runs each ring step's local attention through the
+    Pallas flash kernel (ops/flash_attention.py) instead of the dense
+    einsum. The global causal mask decomposes per step by block position:
+    the step whose K/V block sits on this device's diagonal is a local
+    causal call, blocks from earlier positions are full (non-causal)
+    calls, later blocks contribute nothing — a 3-way ``lax.switch`` on the
+    traced block index. Partials merge by their logsumexp (the kernel
+    emits it; its cotangent folds into Δ in the backward), so the result
+    is exact and the O(S_local²) inner work gets the same 2-3× the
+    single-chip kernel shows.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -57,6 +68,9 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     Sk, Hkv = k.shape[1], k.shape[2]
     if scale is None:
         scale = D ** -0.5
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           scale=scale, n=n, my=my)
     if Hq != Hkv:                                          # GQA: repeat KV heads
         rep = Hq // Hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -91,10 +105,64 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     return o.astype(q.dtype)
 
 
-def dense_attention(q, k, v, *, causal: bool = True,
-                    scale: float | None = None):
-    """Single-device exact attention (same contract, no mesh axis) — the
-    n=1 specialization used by entry()'s single-chip forward."""
+def _ring_flash(q, k, v, *, axis_name, causal, scale, n, my):
+    """Ring loop with the Pallas kernel per step, merging normalized
+    partials by logsumexp: O = (O₁·w₁ + O₂·w₂)/(w₁+w₂), L = M + log Σw,
+    w_i = exp(L_i − M). Fully-masked steps carry L = NEG_INF → weight 0."""
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    B, Sq, Hq, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def diag(k_cur, v_cur):
+        return flash_attention_with_lse(q, k_cur, v_cur, causal=True,
+                                        scale=scale)
+
+    def full(k_cur, v_cur):
+        return flash_attention_with_lse(q, k_cur, v_cur, causal=False,
+                                        scale=scale)
+
+    def masked(k_cur, v_cur):
+        return (jnp.zeros((B, Sq, Hq, D), q.dtype),
+                jnp.full((B, Hq, Sq), NEG_INF, jnp.float32))
+
+    def step(i, carry):
+        o, L, k_cur, v_cur = carry
+        kv_block = (my - i) % n
+        if causal:
+            # 0: diagonal (local causal) · 1: earlier block (full) · 2: later
+            case = jnp.where(kv_block == my, 0, jnp.where(kv_block < my, 1, 2))
+            o_i, lse_i = lax.switch(case, [diag, full, masked], k_cur, v_cur)
+        else:
+            o_i, lse_i = full(k_cur, v_cur)
+        o_i = o_i.astype(jnp.float32)
+
+        M = jnp.maximum(L, lse_i)
+        w_old = jnp.where(L > NEG_INF / 2, jnp.exp(L - M), 0.0)
+        w_new = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - M), 0.0)
+        z = w_old + w_new
+        wo = (w_old / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
+        wn = (w_new / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
+        o = o * wo + o_i * wn
+        L = jnp.where(z > 0, M + jnp.log(jnp.where(z > 0, z, 1.0)), NEG_INF)
+        if n > 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o, L, k_cur, v_cur
+
+    o0 = jnp.zeros((B, Sq, Hq, D), jnp.float32)
+    L0 = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+    o, _, _, _ = lax.fori_loop(0, n, step, (o0, L0, k, v))
+    return o.astype(q.dtype)
+
+
+def dense_attention_with_lse(q, k, v, *, causal: bool = True,
+                             scale: float | None = None):
+    """Single-device exact attention returning (out, lse [B,Hq,Sq]) — the
+    canonical dense implementation; the lse output is the merge handle the
+    flash-ring path needs, and XLA dead-code-eliminates it for callers that
+    drop it. Fully-masked rows yield zeros (not uniform-softmax garbage)
+    and lse = NEG_INF, matching the Pallas kernel's convention."""
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
@@ -109,6 +177,19 @@ def dense_attention(q, k, v, *, causal: bool = True,
         Sq, Sk = q.shape[1], k.shape[1]
         mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where((m > NEG_INF / 2)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return o.astype(q.dtype)
+    o = (o / jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+         ).astype(q.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
+    return o, lse
+
+
+def dense_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None):
+    """Single-device exact attention (same contract, no mesh axis) — the
+    n=1 specialization used by entry()'s single-chip forward."""
+    return dense_attention_with_lse(q, k, v, causal=causal, scale=scale)[0]

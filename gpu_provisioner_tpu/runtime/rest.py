@@ -156,10 +156,12 @@ class KubeConnection:
         return tok
 
     def _stale(self, loop_time: float) -> bool:
-        # fetched-flag, not token truthiness: an exec plugin may validly
-        # yield no token (mTLS via client_cert) and must not re-run per call
-        return (not self._token_fetched
-                or loop_time - self._token_at > TOKEN_REREAD_SECONDS)
+        # exec path: fetched-flag, not token truthiness — a plugin may
+        # validly yield no token (mTLS) and must not re-run per call.
+        # token-file path: truthiness — an empty projected token (kubelet
+        # mid-rotation) must retry on the next call, not cache for 60s.
+        fresh = self._token_fetched if self.exec_argv else bool(self._cached_token)
+        return not fresh or loop_time - self._token_at > TOKEN_REREAD_SECONDS
 
     def bearer(self, loop_time: float) -> str:
         if self.token:
@@ -177,8 +179,10 @@ class KubeConnection:
 
     def build_http(self, opts: Optional[TransportOptions] = None) -> httpx.AsyncClient:
         verify: object = True
-        if self.ca_file:
-            ctx = ssl.create_default_context(cafile=self.ca_file)
+        if self.ca_file or self.client_cert:
+            # client cert loads even without a custom CA (cluster cert signed
+            # by a system CA) — mTLS must not silently depend on ca_file
+            ctx = ssl.create_default_context(cafile=self.ca_file or None)
             if self.client_cert:
                 ctx.load_cert_chain(self.client_cert, self.client_key or None)
             verify = ctx
