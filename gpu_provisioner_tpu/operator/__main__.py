@@ -126,7 +126,14 @@ async def run_real(opts) -> int:
             print(f"error: no in-cluster service account and no usable "
                   f"kubeconfig: {e}", file=sys.stderr)
             return 2
-    kube = RestClient(conn)
+    from ..runtime.informer import CachedListClient
+
+    rest = RestClient(conn)
+    # Informer-backed reads for the list-heavy kinds: both GC loops re-scan
+    # Nodes + NodeClaims every cycle; the cache turns that into watch
+    # maintenance instead of repeated full LISTs (the reference reads
+    # through controller-runtime's cached client the same way).
+    kube = CachedListClient(rest, (Node, NodeClaim))
     kube.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
 
     from ..providers import rest as gcprest
@@ -175,6 +182,7 @@ async def run_real(opts) -> int:
                  extra={"identity": elector.identity})
         await elector.run_until_leading()
 
+    await kube.start()  # informers sync before the first reconcile
     eviction.start()
     await manager.start()
     runners = await start_servers(manager, opts.metrics_port,
@@ -194,11 +202,12 @@ async def run_real(opts) -> int:
     finally:
         await manager.stop()
         await eviction.stop()
+        await kube.stop()
         if elector is not None:
             await elector.stop()
         for r in runners:
             await r.cleanup()
-        await kube.aclose()
+        await rest.aclose()
     return 0
 
 

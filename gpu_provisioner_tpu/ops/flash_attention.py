@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..parallel.ring import dense_attention, dense_attention_with_lse
+from ..parallel.ring import dense_attention_with_lse
 
 NEG_INF = -1.0e30
 # Block-size sweep on v5e (batch 4-8, D=128, bf16, causal): 128×128 leaves
@@ -421,26 +421,6 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out
-
-
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q,
-                           block_k, interpret)
-
-
-_flash_diff.defvjp(_flash_fwd, _flash_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
     B, _, Hq, _ = q.shape
@@ -494,17 +474,10 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
 
     Takes the Pallas kernel only when S tiles exactly into the given
     (hardware-aligned) block sizes and GQA divides evenly; any other shape
-    gets the dense path so callers never have to think about it.
+    gets the dense path so callers never have to think about it. One VJP
+    definition serves both this and the with_lse variant: the dropped lse
+    output is dead-code-eliminated and its zero cotangent folds out of Δ.
     """
-    B, S, Hq, D = q.shape
-    Hkv = k.shape[2]
-    if scale is None:
-        scale = D ** -0.5
-    block_q = _auto_block(S, block_q)
-    block_k = _auto_block(S, block_k)
-    tiles = (S % block_q == 0 and S % block_k == 0 and Hq % Hkv == 0)
-    if not tiles:
-        return dense_attention(q, k, v, causal=causal, scale=scale)
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
-    return _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret)
+    return flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret)[0]

@@ -61,6 +61,7 @@ class FakeKubeAPIServer:
                 self._evict)
         self.runner: Optional[web.AppRunner] = None
         self.port = 0
+        self.list_counts: dict[str, int] = {}
 
     async def start(self) -> str:
         self.runner = web.AppRunner(self.app, shutdown_timeout=1.0)
@@ -104,6 +105,11 @@ class FakeKubeAPIServer:
             return web.Response(status=405)
         if req.query.get("watch") == "true":
             return await self._watch(req, cls)
+        # LIST-load accounting: e2e asserts informer-backed reads keep the
+        # steady-state full-list rate near zero (one count per page walk,
+        # not per page, so pagination doesn't inflate it)
+        if "continue" not in req.query:
+            self.list_counts[cls.KIND] = self.list_counts.get(cls.KIND, 0) + 1
         labels = None
         sel = req.query.get("labelSelector", "")
         if sel:

@@ -232,19 +232,36 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
         pools = await env.cloud.nodepools.list()
         assert len(pools) == 4
 
-        # distinct ordered slice indices, stamped on every member's nodes
-        by_index = {}
-        for n in nodes:
-            idx = n.metadata.labels[wk.TPU_SLICE_INDEX_LABEL]
-            by_index.setdefault(idx, set()).add(
-                n.metadata.labels[wk.GKE_NODEPOOL_LABEL])
-        assert sorted(by_index) == ["0", "1", "2", "3"]
-        assert all(len(pools_) == 1 for pools_ in by_index.values())
+        # distinct ordered slice indices, stamped on every member's nodes.
+        # Polled: the SliceGroupController stamps identity asynchronously
+        # after nodes register — node count reaching 8 does not imply the
+        # labels have converged yet.
+        async def indices_converged():
+            ns = await env._managed_nodes()
+            got = {}
+            for n in ns:
+                idx = n.metadata.labels.get(wk.TPU_SLICE_INDEX_LABEL)
+                if idx is None:
+                    return None
+                got.setdefault(idx, set()).add(
+                    n.metadata.labels[wk.GKE_NODEPOOL_LABEL])
+            ok = (sorted(got) == ["0", "1", "2", "3"]
+                  and all(len(p) == 1 for p in got.values()))
+            return (ns, got) if ok else None
+        nodes, by_index = await env.eventually(
+            indices_converged, what="slice indices stamped on all nodes")
 
-        # one agreed coordinator: worker 0 of slice 0
-        coords = {n.metadata.labels[wk.TPU_COORDINATOR_LABEL] for n in nodes}
+        # one agreed coordinator: worker 0 of slice 0 (stamped by the same
+        # controller pass; polled for the same reason as the indices)
         (pool0,) = by_index["0"]
-        assert coords == {f"gke-kaito-{pool0}-w0"}
+
+        async def coordinator_agreed():
+            ns = await env._managed_nodes()
+            coords = {n.metadata.labels.get(wk.TPU_COORDINATOR_LABEL)
+                      for n in ns}
+            return ns if coords == {f"gke-kaito-{pool0}-w0"} else None
+        nodes = await env.eventually(coordinator_agreed,
+                                     what="coordinator agreed on all nodes")
 
         # every worker bootstraps jax.distributed args from labels alone
         args_seen = []
@@ -349,3 +366,27 @@ async def test_real_mode_plumbing_against_stand_in_cluster(tmp_path, monkeypatch
     finally:
         await gcp_server.stop()
         await kube_server.stop()
+
+
+@fake_only
+@async_test
+async def test_steady_state_list_load_is_flat(tmp_path):
+    """Informer-backed reads: with claims settled, the GC loops must ride
+    the watch cache instead of re-LISTing Nodes/NodeClaims every cycle
+    (reference reads through controller-runtime's cached client). Allows a
+    tiny allowance for the eviction/termination paths that read directly."""
+    async with Environment(tmp_path, gc_interval=0.5) as env:
+        await env.client.create(make_nodeclaim("calm", "tpu-v5e-8"))
+        await env.expect_nodeclaim_ready("calm")
+        await asyncio.sleep(1.0)  # settle in-flight reconciles
+
+        before = dict(env.kube_server.list_counts)
+        await asyncio.sleep(3.0)  # ~6 GC cycles
+        after = dict(env.kube_server.list_counts)
+
+        for kind in ("Node", "NodeClaim"):
+            grew = after.get(kind, 0) - before.get(kind, 0)
+            assert grew <= 2, (
+                f"{kind} full-LIST count grew by {grew} across ~6 GC cycles "
+                f"— informer cache is not serving steady-state reads "
+                f"(before={before}, after={after})")

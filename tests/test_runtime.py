@@ -227,3 +227,61 @@ async def test_singleton_self_requeues():
         await eventually(lambda: len(runs) >= 3)
     finally:
         await mgr.stop()
+
+
+# --- informer cache --------------------------------------------------------
+
+def _informer_test_objs():
+    from gpu_provisioner_tpu.apis.core import Node, NodeSpec
+    from gpu_provisioner_tpu.apis.meta import ObjectMeta
+    return [Node(metadata=ObjectMeta(name=f"n{i}", labels={"grp": "a" if i < 2 else "b"}),
+                 spec=NodeSpec(provider_id=f"gce://p/z/i{i}"))
+            for i in range(3)]
+
+
+@async_test
+async def test_informer_serves_lists_and_tracks_watch():
+    from gpu_provisioner_tpu.apis.core import Node
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+    from gpu_provisioner_tpu.runtime.informer import CachedListClient
+
+    inner = InMemoryClient()
+    for n in _informer_test_objs():
+        await inner.create(n)
+    client = CachedListClient(inner, (Node,))
+    client.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
+
+    # before start: falls through to the inner client
+    assert len(await client.list(Node)) == 3
+
+    await client.start()
+    try:
+        assert len(await client.list(Node)) == 3
+        assert len(await client.list(Node, labels={"grp": "a"})) == 2
+        (hit,) = await client.list(
+            Node, index=("spec.providerID", "gce://p/z/i1"))
+        assert hit.metadata.name == "n1"
+
+        # watch maintenance: create/update/delete reflect without re-listing
+        from gpu_provisioner_tpu.apis.core import NodeSpec
+        from gpu_provisioner_tpu.apis.meta import ObjectMeta
+        await inner.create(Node(metadata=ObjectMeta(name="n9"),
+                                spec=NodeSpec()))
+        await inner.delete(Node, "n0")
+        got = await inner.get(Node, "n1")
+        got.metadata.labels["grp"] = "b"
+        await inner.update(got)
+        await asyncio.sleep(0.05)  # let the pump drain
+        names = sorted(n.metadata.name for n in await client.list(Node))
+        assert names == ["n1", "n2", "n9"]
+        assert len(await client.list(Node, labels={"grp": "b"})) == 2
+
+        # cache isolation: mutating a listed object must not poison the cache
+        (n1,) = [x for x in await client.list(Node)
+                 if x.metadata.name == "n1"]
+        n1.metadata.labels["grp"] = "MUTATED"
+        fresh = [x for x in await client.list(Node)
+                 if x.metadata.name == "n1"][0]
+        assert fresh.metadata.labels["grp"] == "b"
+    finally:
+        await client.stop()
