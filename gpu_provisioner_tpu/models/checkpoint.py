@@ -1,0 +1,81 @@
+"""Sharded train-state checkpointing (orbax).
+
+The control plane is deliberately stateless (SURVEY.md §5 — all state in CR
+conditions + cloud labels); the WORKLOAD is not: a slice-group training job
+must survive preemption/repair, which is routine on TPU capacity (the
+provisioner's auto-repair deletes and replaces unhealthy slices, §3.5). This
+module gives the flagship train loop crash-consistent save/restore:
+
+- saves are **sharding-aware and async-capable**: each host writes only its
+  shards (orbax OCDBT), so multi-host slices checkpoint at ICI/DCN-disjoint
+  disk bandwidth, not through one coordinator;
+- restore is **mesh-flexible**: the target shardings come from the CURRENT
+  mesh's param specs, so a checkpoint taken on a dp-heavy mesh restores onto
+  a tp-heavy one (or a different slice count after repair) with orbax doing
+  the resharding — exactly the elastic-recovery story the provisioner's
+  repair loop implies;
+- the on-disk tree is the logical layer order: pipeline layouts
+  (to_pipeline_layout's interleave) must be applied AFTER restore, keeping
+  checkpoints schedule-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding
+
+from .llama import LlamaConfig, init_params, param_specs
+
+
+def save_train_state(path, params, opt_state, step: int) -> None:
+    """Write {params, opt_state, step} atomically (temp dir + rename, which
+    orbax does internally — a killed save never corrupts the previous one)."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(str(path), {"params": params, "opt_state": opt_state,
+                               "step": step})
+
+
+def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
+    """(params, opt_state, step) restored ONTO ``mesh`` — target shardings
+    derive from the current mesh/specs, not whatever mesh wrote the
+    checkpoint, so restore doubles as reshard.
+
+    ``optimizer`` is required, not defaulted: the abstract opt-state target
+    (shapes AND dtypes) comes from it, and orbax casts stored leaves to the
+    target dtype without complaint — restoring a bf16-mu checkpoint through
+    an f32-mu default would silently diverge from the uninterrupted run."""
+    if specs is None:
+        specs = param_specs(cfg)
+
+    # abstract target: shapes/dtypes from a shape-only init, shardings from
+    # the current mesh — orbax reshards the stored arrays to match
+    shapes = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    abstract_params = jax.tree.map(
+        lambda sd, sp: jax.ShapeDtypeStruct(
+            sd.shape, sd.dtype, sharding=NamedSharding(mesh, sp)),
+        shapes, specs)
+    # opt-state shardings come from compiling optimizer.init against the
+    # abstract params — the same inheritance make_train_state relies on —
+    # so every leaf restores placed, never via orbax's unsafe
+    # sharding-from-file fallback
+    compiled_init = jax.jit(optimizer.init).lower(abstract_params).compile()
+
+    def _on_mesh(sh):
+        # constants (e.g. the Adam step count) compile to a single-device
+        # placement; restore them replicated over the current mesh instead
+        if len(sh.device_set) == mesh.devices.size:
+            return sh
+        return NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    abstract_opt = jax.tree.map(
+        lambda sd, sh: jax.ShapeDtypeStruct(sd.shape, sd.dtype,
+                                            sharding=_on_mesh(sh)),
+        jax.eval_shape(optimizer.init, abstract_params),
+        compiled_init.output_shardings)
+
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(
+            str(path), {"params": abstract_params,
+                        "opt_state": abstract_opt, "step": 0})
+    return restored["params"], restored["opt_state"], int(restored["step"])
