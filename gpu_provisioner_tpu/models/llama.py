@@ -46,6 +46,7 @@ class LlamaConfig:
     dtype: str = "bfloat16"        # activation / matmul dtype
     param_dtype: str = "float32"   # master weights
     remat: bool = False            # jax.checkpoint each block (HBM ↔ FLOPs)
+    seq_schedule: str = "ring"     # "ring" | "zigzag" (balanced causal ring)
     attn_impl: str = "dense"       # "dense" | "flash" (pallas kernel; falls
                                    # back to dense off-TPU / non-tiling shapes)
 

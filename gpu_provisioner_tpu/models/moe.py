@@ -216,7 +216,8 @@ def make_moe_train_step(mesh, cfg: MoEConfig, optimizer=None):
 
     if optimizer is None:
         optimizer = default_optimizer()
-    attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl)
+    attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
+                           seq_schedule=cfg.seq_schedule)
 
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(moe_loss_fn)(

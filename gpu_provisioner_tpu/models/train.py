@@ -34,12 +34,30 @@ def default_optimizer(mu_dtype=None):
     return optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
 
 
-def make_attn_fn(mesh, impl: str = "dense") -> Callable:
+def make_attn_fn(mesh, impl: str = "dense",
+                 seq_schedule: str = "ring") -> Callable:
     """Attention for the mesh: ring over ``seq`` when that axis is sharded;
     otherwise the pallas flash kernel (impl="flash") or dense, shard_mapped
-    so each device runs the kernel on its local (batch, head) shard."""
+    so each device runs the kernel on its local (batch, head) shard.
+    ``seq_schedule="zigzag"`` load-balances the causal ring (every shard
+    holds an early+late chunk pair; see parallel/ring.py) at the cost of a
+    seq permutation outside the shard_map — GSPMD lowers the gathers to
+    all-to-alls on ICI, negligible next to the O(S²/n) attention saved."""
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:
+        if seq_schedule == "zigzag":
+            from ..parallel.ring import zigzag_order, zigzag_ring_attention
+
+            n = mesh.shape[AXIS_SEQ]
+            ring = jax.shard_map(
+                partial(zigzag_ring_attention, axis_name=AXIS_SEQ, impl=impl),
+                mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                out_specs=qkv_spec, check_vma=False)
+
+            def attn(q, k, v):
+                perm, inv = zigzag_order(q.shape[1], n)
+                return ring(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+            return attn
         return jax.shard_map(
             partial(ring_attention, axis_name=AXIS_SEQ, impl=impl),
             mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
@@ -53,10 +71,15 @@ def make_attn_fn(mesh, impl: str = "dense") -> Callable:
     return dense_attention
 
 
-def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None):
+def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None,
+            positions=None):
     """Next-token cross entropy. inputs/targets: [B, S] int32 (pre-shifted —
-    both shard cleanly over ``seq``, unlike a fused [B, S+1] array)."""
-    logits = forward(params, inputs, cfg, attn_fn=attn_fn)
+    both shard cleanly over ``seq``, unlike a fused [B, S+1] array).
+    ``positions`` carries each token's true global position when the caller
+    feeds a permuted sequence (the zigzag schedule); the mean is
+    permutation-invariant so the loss needs no unpermute."""
+    logits = forward(params, inputs, cfg, attn_fn=attn_fn,
+                     positions=positions)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)
@@ -85,14 +108,39 @@ def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
 
     inputs/targets: [B, S] int32, sharded BATCH_SPEC. Donates
     params/opt_state so the update is in-place in HBM.
+
+    zigzag schedule: the TOKEN batch is permuted once per step (true global
+    positions travel to rope via ``positions``; the loss mean is
+    permutation-invariant), so the attention itself runs zigzag-layout with
+    zero per-layer gathers — make_attn_fn's per-call permute wrapper is for
+    standalone attention use, not this path.
     """
+    from functools import partial as _partial
+
     if optimizer is None:
         optimizer = default_optimizer()
-    attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl)
+    zigzag = (cfg.seq_schedule == "zigzag" and mesh.shape[AXIS_SEQ] > 1)
+    if zigzag:
+        from ..parallel.ring import zigzag_order, zigzag_ring_attention
+
+        qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
+        attn_fn = jax.shard_map(
+            _partial(zigzag_ring_attention, axis_name=AXIS_SEQ,
+                     impl=cfg.attn_impl),
+            mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec, check_vma=False)
+    else:
+        attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
+                               seq_schedule=cfg.seq_schedule)
 
     def step(params, opt_state, inputs, targets):
+        positions = None
+        if zigzag:
+            perm, _ = zigzag_order(inputs.shape[1], mesh.shape[AXIS_SEQ])
+            inputs, targets, positions = \
+                inputs[:, perm], targets[:, perm], perm.astype(jnp.int32)
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, inputs, targets, cfg, attn_fn)
+            params, inputs, targets, cfg, attn_fn, positions)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

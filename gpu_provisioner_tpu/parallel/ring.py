@@ -105,6 +105,23 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     return o.astype(q.dtype)
 
 
+def _lse_merge(o, L, o_i, lse_i):
+    """Merge a normalized partial (o_i, lse_i) into the running (o, L):
+    O = (O·w + O_i·w_i)/(w+w_i), L = M + log(w+w_i), w = exp(L−M). The
+    NEG_INF sentinel marks fully-masked partials (weight 0); both guards
+    below exist so masked×masked merges stay finite."""
+    o_i = o_i.astype(jnp.float32)
+    M = jnp.maximum(L, lse_i)
+    w_old = jnp.where(L > NEG_INF / 2, jnp.exp(L - M), 0.0)
+    w_new = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - M), 0.0)
+    z = w_old + w_new
+    wo = (w_old / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
+    wn = (w_new / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
+    o = o * wo + o_i * wn
+    L = jnp.where(z > 0, M + jnp.log(jnp.where(z > 0, z, 1.0)), NEG_INF)
+    return o, L
+
+
 def _ring_flash(q, k, v, *, axis_name, causal, scale, n, my):
     """Ring loop with the Pallas kernel per step, merging normalized
     partials by logsumexp: O = (O₁·w₁ + O₂·w₂)/(w₁+w₂), L = M + log Σw,
@@ -135,16 +152,7 @@ def _ring_flash(q, k, v, *, axis_name, causal, scale, n, my):
             o_i, lse_i = lax.switch(case, [diag, full, masked], k_cur, v_cur)
         else:
             o_i, lse_i = full(k_cur, v_cur)
-        o_i = o_i.astype(jnp.float32)
-
-        M = jnp.maximum(L, lse_i)
-        w_old = jnp.where(L > NEG_INF / 2, jnp.exp(L - M), 0.0)
-        w_new = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - M), 0.0)
-        z = w_old + w_new
-        wo = (w_old / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
-        wn = (w_new / jnp.where(z > 0, z, 1.0)).transpose(0, 2, 1)[..., None]
-        o = o * wo + o_i * wn
-        L = jnp.where(z > 0, M + jnp.log(jnp.where(z > 0, z, 1.0)), NEG_INF)
+        o, L = _lse_merge(o, L, o_i, lse_i)
         if n > 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
@@ -193,3 +201,111 @@ def dense_attention(q, k, v, *, causal: bool = True,
     """Single-device exact attention (same contract, no mesh axis) — the
     n=1 specialization used by entry()'s single-chip forward."""
     return dense_attention_with_lse(q, k, v, causal=causal, scale=scale)[0]
+
+
+# --- zigzag ring: balanced causal schedule ---------------------------------
+
+def zigzag_order(seq_len: int, n: int):
+    """Permutation placing global chunk pair (i, 2n-1-i) on shard i.
+
+    Contiguous causal sharding is imbalanced: shard 0's queries see almost
+    nothing (its ring steps are mostly fully-masked) while shard n-1 works
+    every step — lockstep SPMD pays the max, so ~half the ring's FLOPs are
+    wasted. Pairing the i-th-earliest with the i-th-latest chunk gives every
+    shard an identical causal workload: per step, exactly two chunk-pair
+    attentions are live on every device (the zigzag schedule used for
+    long-context Llama training). Returns (perm, inv) index arrays: apply
+    ``x[:, perm]`` before the seq-sharded shard_map, ``out[:, inv]`` after.
+    """
+    assert seq_len % (2 * n) == 0, (seq_len, n)
+    chunk = seq_len // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * chunk, (i + 1) * chunk))
+        j = 2 * n - 1 - i
+        order.extend(range(j * chunk, (j + 1) * chunk))
+    perm = jnp.array(order)
+    inv = jnp.zeros_like(perm).at[perm].set(jnp.arange(seq_len))
+    return perm, inv
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                          scale: float | None = None, impl: str = "flash"):
+    """Ring attention over zigzag-ordered shards (inside shard_map; the
+    caller permuted the global sequence with ``zigzag_order``).
+
+    Local layout: [B, 2*chunk, H, D] = (early chunk ``my``, late chunk
+    ``2n-1-my``). With kv pair from origin shard j each step:
+
+    - q_late × kv_early: ALWAYS fully visible (every early chunk precedes
+      every late chunk) — one unconditional call;
+    - q_early × kv_early: full if j<my, diagonal if j==my, masked if j>my;
+    - q_late × kv_late: masked if j<my, diagonal if j==my, full if j>my
+      (later j means an EARLIER late chunk 2n-1-j).
+
+    Exactly two live chunk-pairs per device per step — the causal ring's
+    total work, perfectly balanced. Partials merge by logsumexp like
+    ``_ring_flash``; the per-pair compute is the Pallas kernel when shapes
+    tile (flash_attention_with_lse falls back to dense-with-lse below
+    kernel-tiling sizes, so this is also the small-shape path).
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S2, Hq, D = q.shape
+    half = S2 // 2
+    if scale is None:
+        scale = D ** -0.5
+    if not causal:                        # balanced already; plain ring
+        return ring_attention(q, k, v, axis_name=axis_name, causal=False,
+                              scale=scale, impl=impl)
+    pair_attn = (flash_attention_with_lse if impl == "flash"
+                 else dense_attention_with_lse)
+
+    qa, qb = q[:, :half], q[:, half:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def diag(qc, kc, vc):
+        return pair_attn(qc, kc, vc, causal=True, scale=scale)
+
+    def full(qc, kc, vc):
+        return pair_attn(qc, kc, vc, causal=False, scale=scale)
+
+    def masked(qc, kc, vc):
+        return (jnp.zeros(qc.shape, qc.dtype),
+                jnp.full((B, Hq, half), NEG_INF, jnp.float32))
+
+    merge = _lse_merge
+
+    def step(t, carry):
+        oa, La, ob, Lb, k_cur, v_cur = carry
+        j = (my - t) % n
+        ka, kb = k_cur[:, :half], k_cur[:, half:]
+        va, vb = v_cur[:, :half], v_cur[:, half:]
+
+        # q_late × kv_early: unconditionally visible
+        o_i, lse_i = full(qb, ka, va)
+        ob, Lb = merge(ob, Lb, o_i, lse_i)
+
+        # q_early × kv_early: full / diag / masked by ring position
+        case_a = jnp.where(j == my, 1, jnp.where(j < my, 0, 2))
+        o_i, lse_i = lax.switch(case_a, [full, diag, masked], qa, ka, va)
+        oa, La = merge(oa, La, o_i, lse_i)
+
+        # q_late × kv_late: masked / diag / full (reversed order)
+        case_b = jnp.where(j == my, 1, jnp.where(j < my, 2, 0))
+        o_i, lse_i = lax.switch(case_b, [full, diag, masked], qb, kb, vb)
+        ob, Lb = merge(ob, Lb, o_i, lse_i)
+
+        if n > 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return oa, La, ob, Lb, k_cur, v_cur
+
+    oa0 = jnp.zeros((B, half, Hq, D), jnp.float32)
+    ob0 = jnp.zeros((B, half, Hq, D), jnp.float32)
+    L0 = jnp.full((B, Hq, half), NEG_INF, jnp.float32)
+    oa, _, ob, _, _, _ = lax.fori_loop(
+        0, n, step, (oa0, L0, ob0, L0, k, v))
+    return jnp.concatenate([oa, ob], axis=1).astype(q.dtype)
