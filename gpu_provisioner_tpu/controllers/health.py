@@ -96,7 +96,12 @@ class NodeHealthController:
     async def _circuit_broken(self) -> bool:
         if self.opts.max_unhealthy_fraction <= 0:
             return False
-        nodes = await self.client.list(Node)
+        # MANAGED nodes only: system/CPU pools in the denominator would
+        # dilute the fraction and let a bad rollout mass-delete every TPU
+        # slice while the breaker reads "healthy enough"
+        from ..apis import labels as wk
+        nodes = await self.client.list(
+            Node, labels={wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME})
         if not nodes:
             return False
         unhealthy = sum(1 for n in nodes if self._match_policy(n) is not None)

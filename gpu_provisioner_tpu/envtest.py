@@ -19,6 +19,7 @@ from .apis.karpenter import NodeClaim
 from .apis.meta import CONDITION_READY
 from .cloudprovider import MetricsDecorator, TPUCloudProvider
 from .controllers.gc import GCOptions
+from .controllers.health import HealthOptions
 from .controllers.lifecycle import LifecycleOptions
 from .controllers.registry import build_controllers
 from .controllers.termination import TerminationOptions
@@ -47,6 +48,7 @@ class EnvtestOptions:
     # simulated node-ready lag under load or repair reaps claims mid-launch;
     # repair tests shrink it explicitly.
     repair_toleration: float = 30.0
+    repair_max_unhealthy_fraction: float = 0.0
     max_concurrent_reconciles: int = 64
 
 
@@ -79,6 +81,8 @@ class Env:
             termination_options=self.opts.termination,
             gc_options=GCOptions(interval=self.opts.gc_interval,
                                  leak_grace=self.opts.leak_grace),
+            health_options=HealthOptions(
+                max_unhealthy_fraction=self.opts.repair_max_unhealthy_fraction),
             max_concurrent_reconciles=self.opts.max_concurrent_reconciles)
         self.manager = Manager(self.client).register(*controllers)
 

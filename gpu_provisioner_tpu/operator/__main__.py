@@ -102,6 +102,7 @@ async def run_real(opts) -> int:
     from ..auth.credentials import new_credential
     from ..cloudprovider import MetricsDecorator, TPUCloudProvider
     from ..controllers.gc import GCOptions
+    from ..controllers.health import HealthOptions
     from ..controllers.lifecycle import LifecycleOptions
     from ..controllers.registry import build_controllers
     from ..providers.instance import InstanceProvider, ProviderConfig
@@ -167,6 +168,8 @@ async def run_real(opts) -> int:
             instance_requeue=opts.instance_requeue_seconds),
         gc_options=GCOptions(interval=opts.gc_interval_seconds,
                              leak_grace=opts.gc_leak_grace_seconds),
+        health_options=HealthOptions(
+            max_unhealthy_fraction=opts.repair_max_unhealthy_fraction),
         max_concurrent_reconciles=opts.max_concurrent_reconciles,
         node_repair=opts.feature_gates.node_repair,
         cluster=cfg.cluster_name)

@@ -35,6 +35,13 @@ class Options:
     termination_requeue_seconds: float = 5.0   # lifecycle controller.go:246
     instance_requeue_seconds: float = 5.0      # node termination await-instance
     repair_toleration_seconds: float = 600.0   # cloudprovider.go:103-116
+    # Cluster repair circuit breaker: skip auto-repair when more than this
+    # fraction of managed nodes is unhealthy (0 = off, the reference's
+    # active behavior — its breaker is commented out at
+    # health/controller.go:130-151). Worth enabling for TPU fleets: one
+    # bad rollout marking many slices unhealthy must not trigger a mass
+    # delete of expensive capacity.
+    repair_max_unhealthy_fraction: float = 0.0
     max_concurrent_reconciles: int = 64
     simulate: bool = False
     simulate_claims: int = 0
@@ -77,6 +84,8 @@ def parse_options(argv=None, env=None) -> Options:
             e.get("INSTANCE_REQUEUE_SECONDS", "5")),
         repair_toleration_seconds=float(
             e.get("REPAIR_TOLERATION_SECONDS", "600")),
+        repair_max_unhealthy_fraction=float(
+            e.get("REPAIR_MAX_UNHEALTHY_FRACTION", "0")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)

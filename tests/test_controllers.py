@@ -179,6 +179,47 @@ async def test_repair_unhealthy_node_replaces_nodeclaim():
 
 
 @async_test
+async def test_repair_circuit_breaker_halts_mass_repair():
+    """Cluster breaker (health/controller.go:130-151's disabled breaker,
+    enabled here behind an option): when most managed nodes are unhealthy —
+    the signature of a bad rollout, not N independent hardware faults —
+    auto-repair must NOT mass-delete expensive slices."""
+    opts = EnvtestOptions(repair_toleration=0.1,
+                          repair_max_unhealthy_fraction=0.5)
+    async with Env(opts) as env:
+        for name in ("ca", "cb", "cc"):
+            await env.client.create(make_nodeclaim(name))
+        for name in ("ca", "cb", "cc"):
+            await env.wait_ready(name)
+        # 3/3 unhealthy > 0.5 → breaker trips, nothing is reaped. Each flip
+        # restarts the toleration clock (fresh last_transition_time), so the
+        # first node cannot be repaired in the window before the other two
+        # flips land.
+        from gpu_provisioner_tpu.apis.serde import now as _now
+        for name in ("ca", "cb", "cc"):
+            node = await env.client.get(Node, f"gke-kaito-{name}-w0")
+            for c in node.status.conditions:
+                if c.type == "Ready":
+                    c.status = "False"
+                    c.reason = "BadRollout"
+                    c.last_transition_time = _now()
+            await env.client.update_status(node)
+        await asyncio.sleep(1.0)  # several tolerations + reconciles
+        for name in ("ca", "cb", "cc"):
+            assert (await env.client.get(NodeClaim, name)).metadata.name == name
+
+        # recovery drops the fraction under the limit → repair resumes on
+        # the one still-unhealthy node
+        for name in ("cb", "cc"):
+            node = await env.client.get(Node, f"gke-kaito-{name}-w0")
+            for c in node.status.conditions:
+                if c.type == "Ready":
+                    c.status = "True"
+            await env.client.update_status(node)
+        await env.wait_gone("ca", timeout=10)
+
+
+@async_test
 async def test_liveness_timeout_deletes_stuck_claim():
     opts = EnvtestOptions()
     opts.lifecycle.launch_timeout = 0.2
