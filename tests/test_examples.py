@@ -2,6 +2,7 @@
 check for the reference's examples/v1-nodeclaim-gpu.yaml reconciled in
 BASELINE.json's envtest config."""
 
+import pytest
 import glob
 import os
 
@@ -43,3 +44,26 @@ async def test_examples_provision_in_envtest():
             if isinstance(obj, NodeClaim):
                 nc = await env.wait_ready(obj.metadata.name, timeout=30)
                 assert nc.status.provider_id, fname
+
+
+@pytest.mark.e2e
+def test_train_resume_example_runs():
+    """The documented workload example (train → checkpoint → resume on a
+    different mesh layout) runs end to end on the CPU mesh."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "JAX_PLATFORMS": "cpu",
+           # keep the axon site hook out of the subprocess: with the TPU
+           # tunnel absent/wedged its PJRT probe can hang jax init
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "workloads",
+                                      "train_resume.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resuming on mesh" in r.stdout and "done" in r.stdout

@@ -180,3 +180,20 @@ def test_pipeline_train_step_loss_decreases():
                                        put(toks[:, :-1]), put(toks[:, 1:]))
         losses.append(float(loss))
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
+
+
+def test_moe_train_step_with_zigzag_seq_parallel():
+    """The seq_schedule knob reaches the MoE step: zigzag + sp2 trains with
+    a finite, plain-path-consistent loss."""
+    mesh = make_mesh(8, sp=2, ep=2)
+    cfg = replace(MOE, seq_schedule="zigzag")
+    params, opt_state, opt = make_moe_train_state(jax.random.key(0), cfg, mesh)
+    step = make_moe_train_step(mesh, cfg, opt)
+    toks = jax.random.randint(jax.random.key(1), (8, 65), 0, cfg.vocab_size)
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state,
+                                       put(toks[:, :-1]), put(toks[:, 1:]))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
