@@ -36,20 +36,11 @@ def save_train_state(path, params, opt_state, step: int) -> None:
                                "step": step})
 
 
-def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
-    """(params, opt_state, step) restored ONTO ``mesh`` — target shardings
-    derive from the current mesh/specs, not whatever mesh wrote the
-    checkpoint, so restore doubles as reshard.
-
-    ``optimizer`` is required, not defaulted: the abstract opt-state target
-    (shapes AND dtypes) comes from it, and orbax casts stored leaves to the
-    target dtype without complaint — restoring a bf16-mu checkpoint through
-    an f32-mu default would silently diverge from the uninterrupted run."""
+def _abstract_target(mesh, cfg: LlamaConfig, optimizer, specs=None) -> dict:
+    """The restore target: shapes/dtypes from a shape-only init, shardings
+    from the CURRENT mesh — orbax reshards the stored arrays to match."""
     if specs is None:
         specs = param_specs(cfg)
-
-    # abstract target: shapes/dtypes from a shape-only init, shardings from
-    # the current mesh — orbax reshards the stored arrays to match
     shapes = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
     abstract_params = jax.tree.map(
         lambda sd, sp: jax.ShapeDtypeStruct(
@@ -73,9 +64,81 @@ def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
                                             sharding=_on_mesh(sh)),
         jax.eval_shape(optimizer.init, abstract_params),
         compiled_init.output_shardings)
+    return {"params": abstract_params, "opt_state": abstract_opt, "step": 0}
 
+
+def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
+    """(params, opt_state, step) restored ONTO ``mesh`` — target shardings
+    derive from the current mesh/specs, not whatever mesh wrote the
+    checkpoint, so restore doubles as reshard.
+
+    ``optimizer`` is required, not defaulted: the abstract opt-state target
+    (shapes AND dtypes) comes from it, and orbax casts stored leaves to the
+    target dtype without complaint — restoring a bf16-mu checkpoint through
+    an f32-mu default would silently diverge from the uninterrupted run."""
+    target = _abstract_target(mesh, cfg, optimizer, specs)
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(
-            str(path), {"params": abstract_params,
-                        "opt_state": abstract_opt, "step": 0})
+        restored = ckptr.restore(str(path), target)
     return restored["params"], restored["opt_state"], int(restored["step"])
+
+
+class TrainCheckpointManager:
+    """Rotating checkpoint schedule around save/restore_train_state.
+
+    The loop-facing wrapper a long training job needs: save every
+    ``save_interval_steps``, keep the newest ``max_to_keep`` (older ones
+    deleted — TPU-slice-sized states fill disks fast), resume from the
+    newest on restart. Orbax's CheckpointManager provides the bookkeeping;
+    the sharding-aware abstract-target restore is ours (restore_train_state
+    semantics: restores ONTO the current mesh, resharding as needed).
+    """
+
+    def __init__(self, directory, mesh, cfg: LlamaConfig, optimizer,
+                 specs=None, max_to_keep: int = 3,
+                 save_interval_steps: int = 100):
+        self.directory = str(directory)
+        self.mesh = mesh
+        self.cfg = cfg
+        self.optimizer = optimizer
+        self.specs = specs
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps))
+
+    def maybe_save(self, step: int, params, opt_state) -> bool:
+        """Save iff the schedule says so; returns whether a save happened."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(
+                {"params": params, "opt_state": opt_state, "step": step}))
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def wait_until_finished(self) -> None:
+        """Block until in-flight async saves commit."""
+        self._mgr.wait_until_finished()
+
+    def restore_latest(self):
+        """(params, opt_state, step) from the newest checkpoint, placed on
+        the current mesh — or None when the directory is empty (fresh run).
+        Waits out in-flight saves first: the manager registers a step
+        before its files finish committing, so restoring immediately after
+        maybe_save would otherwise read a half-written tree."""
+        self._mgr.wait_until_finished()
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        # restore THROUGH the manager (not a hand-built path — the step
+        # directory layout is orbax's own convention)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(
+                _abstract_target(self.mesh, self.cfg, self.optimizer,
+                                 self.specs)))
+        return (restored["params"], restored["opt_state"],
+                int(restored["step"]))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

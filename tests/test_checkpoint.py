@@ -51,3 +51,28 @@ def test_checkpoint_roundtrip_and_cross_mesh_restore(tmp_path):
     _, _, loss_old = _one_step(mesh_dp, params, opt_state, opt)
     np.testing.assert_allclose(float(loss_new), float(loss_old),
                                atol=2e-2, rtol=2e-3)  # bf16 reduction order
+
+
+def test_checkpoint_manager_rotates_and_resumes(tmp_path):
+    opt = default_optimizer()
+    mesh = make_mesh(8)
+    params, opt_state, _ = make_train_state(jax.random.key(0), CFG, mesh,
+                                            optimizer=opt)
+    from gpu_provisioner_tpu.models.checkpoint import TrainCheckpointManager
+    mgr = TrainCheckpointManager(tmp_path / "ckpts", mesh, CFG, opt,
+                                 max_to_keep=2, save_interval_steps=2)
+    try:
+        saved = [s for s in range(1, 7) if mgr.maybe_save(s, params, opt_state)]
+        # orbax always saves the first step it sees, then every interval
+        assert saved == [1, 2, 4, 6]
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 6
+        assert sorted(int(p.name) for p in (tmp_path / "ckpts").iterdir()
+                      if p.name.isdigit()) == [4, 6]   # rotation
+        r_params, r_opt, step = mgr.restore_latest()
+        assert step == 6
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(r_params),
+                        strict=True):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        mgr.close()
