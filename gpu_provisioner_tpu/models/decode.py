@@ -1,0 +1,161 @@
+"""KV-cache inference: prefill + single-token decode + generate.
+
+The serving-side counterpart of models/train.py — KAITO provisions these
+slices to serve models, so the framework ships the decode loop, TPU-first:
+
+- **one cached forward** serves both phases: prefill runs the whole prompt
+  through it (S tokens, causal within the window, writing the cache),
+  decode runs it with S=1 — no separate code paths to diverge;
+- **static shapes throughout**: the cache is a fixed [L, B, max_len, Hkv, Dh]
+  ring of buffers updated with ``lax.dynamic_update_slice``; attention
+  always scores against the full cache width with a length mask (no
+  data-dependent shapes, so XLA compiles exactly two programs: prefill and
+  decode step);
+- **generate is one ``lax.scan``** over decode steps — the whole
+  autoregressive loop is a single compiled program, no host round-trips
+  per token;
+- tensor parallelism needs nothing new: cache head dims carry the same
+  ``model``-axis specs as the weights (``kv_cache_specs``), and GSPMD
+  inserts the collectives exactly as in training.
+
+GQA: the cache stores Hkv heads (the memory win is the point of GQA);
+scoring groups queries as [B, S, Hkv, group, Dh] against the un-repeated
+cache — the K/V expansion never materializes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import AXIS_MODEL
+from .llama import LlamaConfig, _mlp_half, _project_qkv, _rmsnorm
+
+NEG_INF = -1.0e30
+
+
+class KVCache(NamedTuple):
+    k: jax.Array        # [L, B, max_len, Hkv, Dh]
+    v: jax.Array        # [L, B, max_len, Hkv, Dh]
+    length: jax.Array   # scalar int32 — tokens written so far
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, cfg.act_dtype),
+                   v=jnp.zeros(shape, cfg.act_dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def kv_cache_specs(cfg: LlamaConfig) -> KVCache:
+    """PartitionSpecs mirroring the attention weights' tp layout (kv heads
+    over ``model``) so the cache shards with the model."""
+    spec = P(None, None, None, AXIS_MODEL, None)
+    return KVCache(k=spec, v=spec, length=P())
+
+
+def _cached_attention(q, k_cache, v_cache, start, scale):
+    """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
+    a key at position p is attendable iff p <= start + query_idx (causal,
+    and positions beyond the written prefix are masked by the same bound).
+    GQA: queries grouped [B, S, Hkv, group, Dh]; the cache is never
+    repeated/materialized at Hq width."""
+    B, S, Hq, Dh = q.shape
+    max_len, Hkv = k_cache.shape[1], k_cache.shape[2]
+    group = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, group, Dh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    key_pos = jnp.arange(max_len)                      # [K]
+    q_pos = start + jnp.arange(S)                      # [S]
+    mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(B, S, Hq, Dh).astype(q.dtype)
+
+
+def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
+    """Forward over ``tokens`` [B, S] starting at cache.length; returns
+    (logits [B, S, V], updated cache). S is the prompt for prefill, 1 for a
+    decode step — same program shape either way.
+
+    PRECONDITION (caller-owned): ``cache.length + S <= max_len``. The write
+    index is traced, so this cannot be checked here; past the bound,
+    ``dynamic_update_slice`` clamps and silently corrupts the cache.
+    ``generate`` enforces it; manual decode loops must too."""
+    ad = cfg.act_dtype
+    B, S = tokens.shape
+    start = cache.length
+    positions = start + jnp.arange(S, dtype=jnp.int32)
+    scale = cfg.head_dim ** -0.5
+
+    x = params["embed"].astype(ad)[tokens]
+
+    def body(carry, layer):
+        h = carry
+        lp, k_cache, v_cache = layer
+
+        a = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
+        q, k, v = _project_qkv(a, lp, cfg, positions)
+
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+
+        o = _cached_attention(q, k_cache, v_cache, start, scale)
+        h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
+            @ lp["wo"].astype(ad)
+        h = _mlp_half(h, lp, cfg)
+        return h, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, KVCache(k=k_new, v=v_new, length=start + S)
+
+
+def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig):
+    """(last-token logits [B, V], cache) after consuming the prompt."""
+    logits, cache = cached_forward(params, prompt, cache, cfg)
+    return logits[:, -1], cache
+
+
+def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
+             max_len: int = None, temperature: float = 0.0, key=None):
+    """Autoregressive generation: prefill, then ONE lax.scan of decode
+    steps. prompt: [B, S0] int32 → [B, max_new_tokens] int32.
+    temperature 0 = greedy; otherwise pass ``key`` for sampling."""
+    B, S0 = prompt.shape
+    if max_len is None:
+        max_len = S0 + max_new_tokens
+    assert S0 + max_new_tokens <= max_len, (S0, max_new_tokens, max_len)
+    if temperature > 0 and key is None:
+        key = jax.random.key(0)
+
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    def pick(logits, key):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    keys = (jax.random.split(key, max_new_tokens) if temperature > 0
+            else jnp.zeros((max_new_tokens,)))
+    # first token comes straight from the prefill logits; the scan then does
+    # forward-then-pick, so no decode forward is ever computed and discarded
+    tok0 = pick(logits, keys[0])
+
+    def step(carry, key_t):
+        tok, cache = carry
+        new_logits, cache = cached_forward(params, tok[:, None], cache, cfg)
+        nxt = pick(new_logits[:, 0], key_t)
+        return (nxt, cache), nxt
+
+    (_, _), rest = lax.scan(step, (tok0, cache), keys[1:])
+    return jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)

@@ -137,29 +137,43 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
+def _project_qkv(h, lp, cfg: LlamaConfig, positions):
+    """Normed input → roped (q, k, v). Shared by the training block and the
+    KV-cache decode path (models/decode.py) so the projection/rope math has
+    exactly one home."""
+    B, S, _ = h.shape
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ad = cfg.act_dtype
+    q = (h @ lp["wq"].astype(ad)).reshape(B, S, Hq, Dh)
+    k = (h @ lp["wk"].astype(ad)).reshape(B, S, Hkv, Dh)
+    v = (h @ lp["wv"].astype(ad)).reshape(B, S, Hkv, Dh)
+    return (_rope(q, positions, cfg.rope_theta),
+            _rope(k, positions, cfg.rope_theta), v)
+
+
+def _mlp_half(x, lp, cfg: LlamaConfig):
+    """Norm → SwiGLU → residual (shared with models/decode.py)."""
+    ad = cfg.act_dtype
+    h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ lp["w_gate"].astype(ad)) * (h @ lp["w_up"].astype(ad))
+    return x + gated @ lp["w_down"].astype(ad)
+
+
 def _block_attention_half(x, lp, cfg: LlamaConfig, positions, attn_fn):
     """Norm → QKV → rope → attention → residual (shared with models/moe.py,
     which swaps only the FFN half)."""
     B, S, D = x.shape
-    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     ad = cfg.act_dtype
-
     h = _rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(ad)).reshape(B, S, Hq, Dh)
-    k = (h @ lp["wk"].astype(ad)).reshape(B, S, Hkv, Dh)
-    v = (h @ lp["wv"].astype(ad)).reshape(B, S, Hkv, Dh)
-    q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
-    o = attn_fn(q, k, v).reshape(B, S, Hq * Dh)
+    q, k, v = _project_qkv(h, lp, cfg, positions)
+    o = attn_fn(q, k, v).reshape(B, S, cfg.n_heads * cfg.head_dim)
     return x + o @ lp["wo"].astype(ad)
 
 
 def _block(x, lp, cfg: LlamaConfig, positions, attn_fn):
     """One decoder block. x: [B, S, D], lp: this layer's param slice."""
-    ad = cfg.act_dtype
     x = _block_attention_half(x, lp, cfg, positions, attn_fn)
-    h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-    gated = jax.nn.silu(h @ lp["w_gate"].astype(ad)) * (h @ lp["w_up"].astype(ad))
-    return x + gated @ lp["w_down"].astype(ad)
+    return _mlp_half(x, lp, cfg)
 
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
