@@ -265,6 +265,43 @@ def bench_long_context(fast: bool) -> dict:
     return {"seq_len": S, "step_ms": best * 1e3}
 
 
+def bench_decode(fast: bool) -> dict:
+    """Serving throughput: prefill latency + cached-decode tokens/s on the
+    ~1B model (batch decode, greedy)."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_provisioner_tpu.models.decode import generate
+    from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+
+    dev = jax.devices()[0]
+    cfg = (LlamaConfig(vocab_size=2048, dim=512, n_layers=4, n_heads=8,
+                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16")
+           if fast else
+           LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16"))
+    B, S0, NEW = (2, 64, 16) if fast else (8, 512, 128)
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    prompt = jax.device_put(
+        jnp.zeros((B, S0), jnp.int32), dev)
+
+    gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=NEW))
+
+    def settle(x):
+        x.block_until_ready()
+        return int(x[0, 0])
+
+    settle(gen(params, prompt))                       # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = gen(params, prompt)
+        settle(out)
+        best = min(best, time.perf_counter() - t0)
+    return {"batch": B, "prompt_len": S0, "new_tokens": NEW,
+            "total_ms": best * 1e3,
+            "decode_tokens_per_s": B * NEW / best}
+
+
 def bench_flash_op(fast: bool) -> dict:
     """Pallas flash-attention kernel vs the dense lax path, one op."""
     import jax
@@ -341,6 +378,7 @@ def main(argv=None) -> int:
         try:
             extra["workload"] = rounded(bench_workload(args.fast))
             extra["flash_attention"] = rounded(bench_flash_op(args.fast))
+            extra["decode"] = rounded(bench_decode(args.fast))
         except Exception as e:  # no usable accelerator — control plane still counts
             extra["workload_error"] = f"{type(e).__name__}: {e}"
         try:
