@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..parallel.ring import dense_attention
 from ..parallel.topology import AXIS_MODEL
 from .llama import LlamaConfig, _mlp_half, _project_qkv, _rmsnorm
 
@@ -118,9 +119,51 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
     return logits, KVCache(k=k_new, v=v_new, length=start + S)
 
 
-def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig):
-    """(last-token logits [B, V], cache) after consuming the prompt."""
-    logits, cache = cached_forward(params, prompt, cache, cfg)
+def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
+    """Prefill specialization for a FRESH cache: with nothing written yet,
+    attention is plain causal attention over the prompt window — S×S scores
+    (flash-kernel eligible via cfg.attn_impl) instead of cached_forward's
+    S×max_len masked sweep, and the cache is written once at offset 0."""
+    ad = cfg.act_dtype
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)
+    if cfg.attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention as attn
+    else:
+        attn = dense_attention
+
+    x = params["embed"].astype(ad)[tokens]
+
+    def body(h, lp):
+        a = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
+        q, k, v = _project_qkv(a, lp, cfg, positions)
+        o = attn(q, k, v)
+        h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
+            @ lp["wo"].astype(ad)
+        h = _mlp_half(h, lp, cfg)
+        return h, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+
+    pad = [(0, 0), (0, 0), (0, max_len - S), (0, 0), (0, 0)]
+    cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
+                    length=jnp.asarray(S, jnp.int32))
+    return logits, cache
+
+
+def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
+            fresh: bool = False):
+    """(last-token logits [B, V], cache) after consuming the prompt.
+    ``fresh=True`` (statically-known-empty cache, e.g. from generate) takes
+    the S×S fast path; otherwise the general cached forward runs, correct
+    for continuing a partially-filled cache."""
+    if fresh:
+        logits, cache = _prefill_forward(params, prompt,
+                                         cache.k.shape[2], cfg)
+    else:
+        logits, cache = cached_forward(params, prompt, cache, cfg)
     return logits[:, -1], cache
 
 
@@ -137,7 +180,7 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
         key = jax.random.key(0)
 
     cache = init_kv_cache(cfg, B, max_len)
-    logits, cache = prefill(params, prompt, cache, cfg)
+    logits, cache = prefill(params, prompt, cache, cfg, fresh=True)
 
     def pick(logits, key):
         if temperature <= 0:

@@ -72,3 +72,27 @@ def test_generate_tensor_parallel_on_mesh():
         lambda p, t: generate(p, t, CFG, max_new_tokens=4))(host, prompt)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
     assert kv_cache_specs(CFG).k == P(None, None, None, "model", None)
+
+
+def test_fresh_prefill_fast_path_matches_general():
+    """fresh=True prefill (S x S causal + one cache write) must agree with
+    the general cached forward on logits, cache contents, and length."""
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 12), 0, CFG.vocab_size)
+    fast_logits, fast_cache = prefill(params, prompt,
+                                      init_kv_cache(CFG, 2, 32), CFG,
+                                      fresh=True)
+    gen_logits, gen_cache = prefill(params, prompt,
+                                    init_kv_cache(CFG, 2, 32), CFG)
+    np.testing.assert_allclose(np.asarray(fast_logits),
+                               np.asarray(gen_logits), atol=3e-2, rtol=3e-2)
+    assert int(fast_cache.length) == int(gen_cache.length) == 12
+    np.testing.assert_allclose(
+        np.asarray(fast_cache.k.astype(jnp.float32)),
+        np.asarray(gen_cache.k.astype(jnp.float32)), atol=3e-2, rtol=3e-2)
+    # and decode continues identically from either cache
+    nxt = jax.random.randint(jax.random.key(2), (2, 1), 0, CFG.vocab_size)
+    a, _ = cached_forward(params, nxt, fast_cache, CFG)
+    b, _ = cached_forward(params, nxt, gen_cache, CFG)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=3e-2, rtol=3e-2)
