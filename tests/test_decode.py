@@ -96,3 +96,15 @@ def test_fresh_prefill_fast_path_matches_general():
     b, _ = cached_forward(params, nxt, gen_cache, CFG)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_generate_sampling_reproducible_and_in_vocab():
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)
+    out1 = generate(params, prompt, CFG, max_new_tokens=4, temperature=0.8,
+                    key=jax.random.key(7))
+    out2 = generate(params, prompt, CFG, max_new_tokens=4, temperature=0.8,
+                    key=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert out1.shape == (2, 4)
+    assert int(out1.min()) >= 0 and int(out1.max()) < CFG.vocab_size
