@@ -7,6 +7,15 @@ decoder in pure JAX, sharded over the mesh built from the provisioner's
 topology labels (parallel/topology.py).
 """
 
+from .checkpoint import (TrainCheckpointManager, restore_train_state,
+                         save_train_state)
+from .decode import KVCache, generate, init_kv_cache, prefill
 from .llama import LlamaConfig, forward, init_params, param_specs
+from .train import make_train_state, make_train_step
 
-__all__ = ["LlamaConfig", "init_params", "forward", "param_specs"]
+__all__ = [
+    "LlamaConfig", "init_params", "forward", "param_specs",
+    "make_train_state", "make_train_step",
+    "KVCache", "init_kv_cache", "prefill", "generate",
+    "save_train_state", "restore_train_state", "TrainCheckpointManager",
+]
