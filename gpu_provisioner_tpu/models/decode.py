@@ -59,13 +59,26 @@ def kv_cache_specs(cfg: LlamaConfig) -> KVCache:
     return KVCache(k=spec, v=spec, length=P())
 
 
-def _cached_attention(q, k_cache, v_cache, start, scale):
+def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense"):
     """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
     a key at position p is attendable iff p <= start + query_idx (causal,
     and positions beyond the written prefix are masked by the same bound).
     GQA: queries grouped [B, S, Hkv, group, Dh]; the cache is never
-    repeated/materialized at Hq width."""
+    repeated/materialized at Hq width.
+
+    ``impl="flash"``: prefill-sized S (tiles into ≥128 blocks) takes the
+    cache-aware Pallas kernel (ops/flash_attention.py:flash_attention_cached)
+    — blocks past the causal frontier are neither computed nor DMA'd, so
+    continuing a partially-filled cache stops paying the dense S×max_len
+    sweep. S=1 decode steps always use the dense path (a GEMV-shaped op the
+    kernel can't tile)."""
     B, S, Hq, Dh = q.shape
+    if impl == "flash":
+        from ..ops.flash_attention import (cached_flash_supported,
+                                           flash_attention_cached)
+        if cached_flash_supported(S, k_cache.shape[1], Hq, k_cache.shape[2]):
+            return flash_attention_cached(q, k_cache, v_cache, start,
+                                          scale=scale)
     max_len, Hkv = k_cache.shape[1], k_cache.shape[2]
     group = Hq // Hkv
     qg = q.reshape(B, S, Hkv, group, Dh)
@@ -107,7 +120,8 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
 
-        o = _cached_attention(q, k_cache, v_cache, start, scale)
+        o = _cached_attention(q, k_cache, v_cache, start, scale,
+                              impl=cfg.attn_impl)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         h = _mlp_half(h, lp, cfg)

@@ -112,6 +112,52 @@ def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
     lse_ref[0] = lse                                      # [BQ, 1]
 
 
+def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                         q_pos0, kv_pos0, block_q, block_k, scale, masked):
+    """One flash tile: S = qKᵀ·scale (masked below q_pos0+i ≥ kv_pos0+j when
+    ``masked``), then the running-max/denominator update into VMEM scratch.
+    Shared by the streaming self-attention and KV-cache kernels so numerics
+    fixes land in one place."""
+    q = q_ref[0].astype(jnp.float32)                  # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)                  # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+    if masked:
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        kv_pos = kv_pos0 + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_blk = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new > NEG_INF / 2, p, 0.0)        # fully-masked rows
+    corr = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _init_softmax_scratch(acc_ref, m_ref, l_ref):
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+
+def _finalize_out(o_ref, acc_ref, m_ref, l_ref, lse_ref=None):
+    l = l_ref[:]
+    o_ref[0] = (acc_ref[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        m = m_ref[:]
+        lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
+        lse_ref[0] = lse                              # [BQ, 1]
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             block_q, block_k, scale, causal):
     qi = pl.program_id(1)
@@ -120,46 +166,21 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kj == 0)
     def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
+        _init_softmax_scratch(acc_ref, m_ref, l_ref)
 
     # whole block above the causal diagonal → no compute
     live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)                  # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [BQ, BK]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_blk = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(s - m_new)
-        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)        # fully-masked rows
-        corr = jnp.exp(m_prev - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _online_softmax_step(
+            q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+            q_pos0=qi * block_q, kv_pos0=kj * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, masked=causal)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
-        l = l_ref[:]
-        o_ref[0] = (acc_ref[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
-        m = m_ref[:]
-        lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
-        lse_ref[0] = lse                                  # [BQ, 1]
+        _finalize_out(o_ref, acc_ref, m_ref, l_ref, lse_ref)
 
 
 def _heads_to_rows(x):
@@ -171,6 +192,42 @@ def _heads_to_rows(x):
 def _rows_to_heads(x, B, H):
     BH, S, D = x.shape
     return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _causal_kv_index(block_q, block_k, group, causal, *,
+                     prefetch_start=False):
+    """kv-side index map for (bh, qi, kj) grids. Under causal masking the
+    blocks past the diagonal are clamped to the last live block so the block
+    index repeats across the dead tail of the kj loop and the Pallas
+    pipeline skips the DMA (a revisited block is not re-fetched).
+    ``prefetch_start``: the KV-cache variant, where the diagonal sits at a
+    dynamic offset carried by a scalar-prefetch ref (extra trailing arg)."""
+    if prefetch_start:
+        def idx(bh, qi, kj, start_ref, g=group):
+            last = (start_ref[0] + qi * block_q + block_q - 1) // block_k
+            return (bh // g, jnp.minimum(kj, last), 0)
+        return idx
+    if not causal:
+        return lambda bh, qi, kj, g=group: (bh // g, kj, 0)
+
+    def idx(bh, qi, kj, g=group):
+        last = (qi * block_q + block_q - 1) // block_k
+        return (bh // g, jnp.minimum(kj, last), 0)
+    return idx
+
+
+def _causal_q_index(block_q, block_k, causal):
+    """q-side index map for (bh, kj, qi) grids (the dK/dV pass). The dead
+    prefix of the qi loop (blocks strictly before the diagonal) is clamped
+    UP to the first live block — the same index repeats from step 0 through
+    the first live step, so those DMAs are elided too."""
+    if not causal:
+        return lambda bh, kj, qi: (bh, qi, 0)
+
+    def idx(bh, kj, qi):
+        first = (kj * block_k) // block_q
+        return (bh, jnp.maximum(qi, first), 0)
+    return idx
 
 
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -217,18 +274,20 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _kernel, block_q=block_q, block_k=block_k, scale=scale, causal=causal)
+    # Causal: kv blocks above the diagonal are dead. Clamping their index to
+    # the last live block makes the index map constant across the dead tail
+    # of the kj loop, so the pipeline elides the re-fetch — fully-masked
+    # blocks cost neither compute (the `live` gate in the kernel) nor HBM
+    # traffic (this clamp). At long S that halves K/V read traffic.
+    kv_idx = _causal_kv_index(block_q, block_k, group, causal)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * Hq, S // block_q, S // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D),
-                         lambda bh, qi, kj, g=group: (bh // g, kj, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D),
-                         lambda bh, qi, kj, g=group: (bh // g, kj, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
@@ -245,6 +304,120 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qf, kf, vf)
     return _rows_to_heads(out, B, Hq), lse
+
+
+# --- KV-cache (serving) forward --------------------------------------------
+
+def _kernel_cached(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, block_q, block_k, scale):
+    """Streaming flash where the query block sits at cache positions
+    ``start + qi·BQ ..`` against a [max_len]-wide KV cache. ``start`` is a
+    traced scalar riding as a scalar-prefetch argument so both the mask and
+    the kv index map see it. A key block is live iff its first position is
+    ≤ the query block's last position — everything past the causal frontier
+    (which also bounds the written prefix, since the new tokens' keys are
+    written before scoring — models/decode.py cached_forward) is neither
+    computed nor fetched."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    start = start_ref[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        _init_softmax_scratch(acc_ref, m_ref, l_ref)
+
+    live = kj * block_k <= start + qi * block_q + block_q - 1
+
+    @pl.when(live)
+    def _step():
+        _online_softmax_step(
+            q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+            q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, masked=True)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        _finalize_out(o_ref, acc_ref, m_ref, l_ref)
+
+
+def cached_flash_supported(S: int, max_len: int, Hq: int, Hkv: int,
+                           block_q: int = None, block_k: int = None) -> bool:
+    """True iff flash_attention_cached can take these shapes (S and max_len
+    tile into ≥128-aligned blocks, GQA divides). S=1 decode steps and ragged
+    prompts return False — callers keep the dense masked sweep."""
+    bq = _auto_block(S, block_q)
+    bk = _auto_block(max_len, block_k)
+    return (S % bq == 0 and max_len % bk == 0 and Hq % Hkv == 0
+            and bq >= 128 and bk >= 128)
+
+
+def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
+                           block_q: int = None, block_k: int = None,
+                           interpret: bool = None):
+    """Flash attention of fresh-token queries against a KV cache — the
+    serving prefill-continuation path (forward-only, no VJP; decode never
+    differentiates). Replaces the dense S×max_len masked sweep of
+    models/decode.py:_cached_attention when shapes tile.
+
+    q: [B, S, Hq, D] queries at cache positions start..start+S-1;
+    k_cache/v_cache: [B, max_len, Hkv, D] with those positions already
+    written; ``start``: traced int32 scalar. Returns [B, S, Hq, D].
+    Callers must gate on cached_flash_supported().
+
+    Sharding note: under a tensor-parallel mesh the GSPMD partitioner cannot
+    split a pallas_call, so a kv-head-sharded cache is gathered around the
+    kernel (results match dense on the 8-device CPU interpret-mode tp=2 test
+    mesh; like every kernel here, on-chip lowering must be validated once on
+    real TPU — interpret mode can't catch lowering errors). Single-replica
+    serving (today's deployment shape) pays nothing; a shard_map'd serving
+    wrapper is the follow-up if tp serving at large max_len becomes real."""
+    B, S, Hq, D = q.shape
+    ML, Hkv = k_cache.shape[1], k_cache.shape[2]
+    group = Hq // Hkv
+    if scale is None:
+        scale = D ** -0.5
+    block_q = _auto_block(S, block_q)
+    block_k = _auto_block(ML, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    qf = _heads_to_rows(q)
+    kf, vf = _heads_to_rows(k_cache), _heads_to_rows(v_cache)
+    start_arr = jnp.asarray(start, jnp.int32).reshape(1)
+
+    def q_idx(bh, qi, kj, start_ref):
+        return (bh, qi, 0)
+
+    # clamp to the dynamic causal frontier: dead blocks repeat the last
+    # live index, so the pipeline elides their DMA
+    kv_idx = _causal_kv_index(block_q, block_k, group, True,
+                              prefetch_start=True)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * Hq, S // block_q, ML // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), q_idx,
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_cached, block_q=block_q, block_k=block_k,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+        interpret=interpret,
+    )(start_arr, qf, kf, vf)
+    return _rows_to_heads(out, B, Hq)
 
 
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------
@@ -369,8 +542,9 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
+    # same dead-block DMA elision as the forward (see _causal_kv_index)
     kvspec = pl.BlockSpec((1, block_k, D),
-                          lambda bh, qi, kj, g_=group: (bh // g_, kj, 0),
+                          _causal_kv_index(block_q, block_k, group, causal),
                           memory_space=pltpu.VMEM)
     rowq = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0),
                         memory_space=pltpu.VMEM)
@@ -389,13 +563,14 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
     # dK/dV per q-head (grid bh spans B*Hq); GQA folds group q-heads onto
     # their kv-head after the kernel — keeps grid cells race-free.
-    qspec2 = pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0),
-                          memory_space=pltpu.VMEM)
+    # q-side dead-prefix elision (see _causal_q_index); kv blocks are
+    # indexed by the outer kj and already fetched once per kv grid row.
+    q_idx2 = _causal_q_index(block_q, block_k, causal)
+    qspec2 = pl.BlockSpec((1, block_q, D), q_idx2, memory_space=pltpu.VMEM)
     kvspec2 = pl.BlockSpec((1, block_k, D),
                            lambda bh, kj, qi, g_=group: (bh // g_, kj, 0),
                            memory_space=pltpu.VMEM)
-    rowq2 = pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0),
-                         memory_space=pltpu.VMEM)
+    rowq2 = pl.BlockSpec((1, block_q, 1), q_idx2, memory_space=pltpu.VMEM)
     dkv_out = pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0),
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(

@@ -98,6 +98,68 @@ def test_fresh_prefill_fast_path_matches_general():
                                atol=3e-2, rtol=3e-2)
 
 
+def test_multiturn_flash_prefill_matches_dense():
+    """Multi-turn serving: prefill a block-sized prompt, decode a few, then
+    prefill a second turn — attn_impl="flash" (cache-aware Pallas kernel on
+    the S≥128 turns, dense on S=1 steps) must match attn_impl="dense"
+    end-to-end on logits, cache contents, and length."""
+    import dataclasses
+
+    cfg_d = dataclasses.replace(CFG, max_seq_len=512)
+    cfg_f = dataclasses.replace(cfg_d, attn_impl="flash")
+    params = init_params(jax.random.key(0), cfg_d)
+    turn1 = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                               cfg_d.vocab_size)
+    turn2 = jax.random.randint(jax.random.key(2), (2, 128), 0,
+                               cfg_d.vocab_size)
+
+    def serve(cfg):
+        cache = init_kv_cache(cfg, 2, 384)
+        l1, cache = cached_forward(params, turn1, cache, cfg)   # start=0
+        tok = jnp.argmax(l1[:, -1:], axis=-1).astype(jnp.int32)
+        for _ in range(2):                                      # S=1 decode
+            ld, cache = cached_forward(params, tok, cache, cfg)
+            tok = jnp.argmax(ld[:, -1:], axis=-1).astype(jnp.int32)
+        l2, cache = cached_forward(params, turn2, cache, cfg)   # start=130
+        return l1, l2, cache
+
+    l1d, l2d, cd = serve(cfg_d)
+    l1f, l2f, cf = serve(cfg_f)
+    np.testing.assert_allclose(np.asarray(l1f), np.asarray(l1d),
+                               atol=3e-2, rtol=3e-2)
+    np.testing.assert_allclose(np.asarray(l2f), np.asarray(l2d),
+                               atol=3e-2, rtol=3e-2)
+    assert int(cf.length) == int(cd.length) == 258
+    np.testing.assert_allclose(np.asarray(cf.k.astype(jnp.float32)),
+                               np.asarray(cd.k.astype(jnp.float32)),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_flash_prefill_on_tp_mesh_matches_dense():
+    """attn_impl="flash" serving on a tensor-parallel mesh (kv-head-sharded
+    cache): GSPMD gathers around the pallas_call — results must match the
+    dense impl under the SAME sharding (isolates the kernel from tp's own
+    bf16 reduction-order noise)."""
+    import dataclasses
+
+    cfg_d = dataclasses.replace(CFG, max_seq_len=512)
+    cfg_f = dataclasses.replace(cfg_d, attn_impl="flash")
+    mesh = make_mesh(8, tp=2)
+    params = shard_params(init_params(jax.random.key(0), cfg_d), mesh, cfg_d)
+    prompt = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                                cfg_d.vocab_size)
+    outs = {}
+    for cfg in (cfg_d, cfg_f):
+        cache = init_kv_cache(cfg, 2, 256)
+        logits, cache = jax.jit(cached_forward, static_argnums=3)(
+            params, prompt, cache, cfg)
+        outs[cfg.attn_impl] = (logits, cache)
+    np.testing.assert_allclose(np.asarray(outs["flash"][0]),
+                               np.asarray(outs["dense"][0]),
+                               atol=3e-2, rtol=3e-2)
+    assert int(outs["flash"][1].length) == 128
+
+
 def test_generate_sampling_reproducible_and_in_vocab():
     params = init_params(jax.random.key(0), CFG)
     prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)

@@ -80,6 +80,55 @@ def test_flash_falls_back_on_non_tiling_shapes():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("start", [0, 37, 130, 384])
+def test_cached_flash_matches_dense_masked_sweep(start):
+    """flash_attention_cached (scalar-prefetch start, dynamic causal
+    frontier) vs the dense S×max_len masked sweep it replaces."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        cached_flash_supported, flash_attention_cached)
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    k_cache = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_cache = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    assert cached_flash_supported(S, ML, Hq, Hkv)
+    scale = D ** -0.5
+    start = jnp.asarray(start, jnp.int32)
+    out = flash_attention_cached(q, k_cache, v_cache, start, scale=scale)
+    ref = _cached_attention(q, k_cache, v_cache, start, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_cached_flash_under_jit_traced_start():
+    """start is traced in the serving loop — the kernel must accept it."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 1, 128, 256, 2, 1, 32
+    ks = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    vc = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    f = jax.jit(lambda s: flash_attention_cached(q, kc, vc, s))
+    for s in (0, 65, 128):
+        ref = _cached_attention(q, kc, vc, jnp.asarray(s), D ** -0.5)
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray(s, jnp.int32))),
+                                   np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_cached_flash_supported_gates():
+    from gpu_provisioner_tpu.ops.flash_attention import cached_flash_supported
+    assert cached_flash_supported(128, 512, 4, 2)
+    assert not cached_flash_supported(1, 512, 4, 2)      # decode step
+    assert not cached_flash_supported(100, 512, 4, 2)    # ragged prompt
+    assert cached_flash_supported(128, 300, 4, 2)        # ≤512: one full block
+    assert not cached_flash_supported(128, 600, 4, 2)    # ragged long cache
+    assert not cached_flash_supported(128, 512, 4, 3)    # GQA doesn't divide
+
+
 def test_flash_under_shard_map_on_mesh():
     """impl="flash" path of make_attn_fn: per-device kernel on (data, model)
     shards, seq unsharded."""
