@@ -32,9 +32,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.ring import dense_attention
 from ..parallel.topology import AXIS_MODEL
-from .llama import LlamaConfig, _mlp_half, _project_qkv, _rmsnorm
+from .llama import (LlamaConfig, _mlp_half, _project_qkv, _rmsnorm,
+                    resolve_attn as _resolve_attn)
 
 NEG_INF = -1.0e30
 
@@ -102,6 +102,8 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
     index is traced, so this cannot be checked here; past the bound,
     ``dynamic_update_slice`` clamps and silently corrupts the cache.
     ``generate`` enforces it; manual decode loops must too."""
+    _resolve_attn(cfg.attn_impl)  # validate loudly — the dense fallback in
+    # _cached_attention is shape-driven, not a typo escape hatch
     ad = cfg.act_dtype
     B, S = tokens.shape
     start = cache.length
@@ -141,10 +143,7 @@ def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
     ad = cfg.act_dtype
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)
-    if cfg.attn_impl == "flash":
-        from ..ops.flash_attention import flash_attention as attn
-    else:
-        attn = dense_attention
+    attn = _resolve_attn(cfg.attn_impl)
 
     x = params["embed"].astype(ad)[tokens]
 

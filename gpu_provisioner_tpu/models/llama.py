@@ -68,6 +68,18 @@ PRESETS = {
 }
 
 
+def resolve_attn(impl: str) -> Callable:
+    """cfg.attn_impl → attention callable (the one dispatch point — forward,
+    the pipelined stage body, and serving prefill all resolve through here).
+    Unknown values raise instead of silently running dense."""
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention
+    if impl == "dense":
+        return dense_attention
+    raise ValueError(f"unknown attn_impl {impl!r}; expected 'dense'|'flash'")
+
+
 def init_params(key, cfg: LlamaConfig) -> dict:
     """Stacked-layer parameter pytree. Truncated-normal-ish scaled init."""
     pd = jnp.dtype(cfg.param_dtype)
@@ -187,11 +199,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     sequence axis is sharded.
     """
     if attn_fn is None:
-        if cfg.attn_impl == "flash":
-            from ..ops import flash_attention
-            attn_fn = flash_attention
-        else:
-            attn_fn = dense_attention
+        attn_fn = resolve_attn(cfg.attn_impl)
     ad = cfg.act_dtype
     B, S = tokens.shape
     if positions is None:

@@ -20,9 +20,10 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.ring import dense_attention, ring_attention
+from ..parallel.ring import ring_attention
 from ..parallel.topology import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_SLICE
-from .llama import LlamaConfig, forward, init_params, param_specs
+from .llama import (LlamaConfig, forward, init_params, param_specs,
+                    resolve_attn)
 
 BATCH_SPEC = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
 
@@ -43,6 +44,7 @@ def make_attn_fn(mesh, impl: str = "dense",
     holds an early+late chunk pair; see parallel/ring.py) at the cost of a
     seq permutation outside the shard_map — GSPMD lowers the gathers to
     all-to-alls on ICI, negligible next to the O(S²/n) attention saved."""
+    attn = resolve_attn(impl)   # validates impl for every branch below
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:
         if seq_schedule == "zigzag":
@@ -63,12 +65,11 @@ def make_attn_fn(mesh, impl: str = "dense",
             mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec, check_vma=False)
     if impl == "flash":
-        from ..ops import flash_attention
         return jax.shard_map(
-            flash_attention, mesh=mesh,
+            attn, mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec, check_vma=False)
-    return dense_attention
+    return attn
 
 
 def loss_fn(params, inputs, targets, cfg: LlamaConfig, attn_fn=None,
@@ -164,27 +165,33 @@ def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
     specs, blocks layer-sharded over ``pipe`` AND tensor-parallel over
     ``model`` within each stage (partial-manual shard_map — GSPMD inserts
     the tp collectives inside stages). Composes with (slice, data) batch
-    sharding and with ``seq`` sharding: attention inside a stage is dense
-    under GSPMD, which all-gathers k/v over the sequence shards (ring
-    attention's manual overlap stays exclusive to the non-pipelined path —
-    nesting a second manual region inside the pipe region buys nothing at
-    stage-local sequence lengths). ``n_chunks>1`` switches the schedule to
-    Megatron-interleaved, shrinking the pipeline bubble and ramp waste by
-    that factor."""
+    sharding and with ``seq`` sharding.
+
+    Attention inside a stage follows ``cfg.attn_impl``: "flash" calls the
+    Pallas kernel straight from the stage body (it runs under auto_axes, so
+    GSPMD gathers the non-pipe shards around the unpartitionable
+    pallas_call — free at pp>1's usual tp-light configs and exactly local
+    on a single chip, and long-context training per stage stops paying the
+    O(S²) dense score matrix); "dense" keeps the all-gathered dense path
+    (ring attention's manual overlap stays exclusive to the non-pipelined
+    path — nesting a second manual region inside the pipe region buys
+    nothing at stage-local sequence lengths). ``n_chunks>1`` switches the
+    schedule to Megatron-interleaved, shrinking the pipeline bubble and
+    ramp waste by that factor."""
     from ..parallel.pipeline import pipelined_blocks
     from .llama import _block, _rmsnorm
 
     if optimizer is None:
         optimizer = default_optimizer()
     state_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
+    stage_attn = resolve_attn(cfg.attn_impl)
 
     def pipelined_forward(params, tokens):
         ad = cfg.act_dtype
         B, S = tokens.shape
         positions = jnp.arange(S, dtype=jnp.int32)
         x = params["embed"].astype(ad)[tokens]
-        block_fn = lambda lp, h: _block(h, lp, cfg, positions,
-                                        dense_attention)
+        block_fn = lambda lp, h: _block(h, lp, cfg, positions, stage_attn)
         apply = pipelined_blocks(block_fn, mesh, cfg.n_layers, n_micro,
                                  n_chunks=n_chunks, state_spec=state_spec)
         x = apply(params["blocks"], x)

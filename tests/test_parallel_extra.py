@@ -77,6 +77,7 @@ from gpu_provisioner_tpu.models.train import (loss_fn,
 from gpu_provisioner_tpu.parallel.pipeline import (from_pipeline_layout,
                                                    interleave_layer_order,
                                                    to_pipeline_layout)
+from gpu_provisioner_tpu.parallel.ring import dense_attention
 
 CFG4 = replace(CFG, n_layers=4, dtype="float32")
 
@@ -121,25 +122,30 @@ def test_pipelined_forward_matches_plain():
                                atol=6e-2, rtol=6e-2)  # bf16 activations
 
 
-def _check_pipeline_matches_plain(mesh, n_chunks, n_micro=2):
+def _check_pipeline_matches_plain(mesh, n_chunks, n_micro=2, cfg=CFG4,
+                                  batch=8, seq=32, steps=3):
     """First-step loss must equal the plain (non-pipelined) path on the
     same params/batch, and training must make progress."""
-    host = init_params(jax.random.key(0), CFG4)
+    host = init_params(jax.random.key(0), cfg)
     params = copy.deepcopy(host)
     params["blocks"] = to_pipeline_layout(
-        params["blocks"], CFG4.n_layers, mesh.shape["pipe"], n_chunks)
+        params["blocks"], cfg.n_layers, mesh.shape["pipe"], n_chunks)
     params = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, pipeline_param_specs(CFG4))
+        params, pipeline_param_specs(cfg))
     opt = default_optimizer()
     opt_state = jax.jit(opt.init)(params)
-    step = make_pipeline_train_step(mesh, CFG4, n_micro=n_micro,
+    step = make_pipeline_train_step(mesh, cfg, n_micro=n_micro,
                                     n_chunks=n_chunks, optimizer=opt)
-    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG4.vocab_size)
+    toks = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                              cfg.vocab_size)
     put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
-    want = float(loss_fn(host, toks[:, :-1], toks[:, 1:], CFG4))
+    # reference loss: plain non-pipelined path with DENSE attention — a
+    # flash cfg must still agree (kernel equivalence ride-along)
+    want = float(loss_fn(host, toks[:, :-1], toks[:, 1:], cfg,
+                         dense_attention))
     losses = []
-    for _ in range(3):
+    for _ in range(steps):
         params, opt_state, loss = step(params, opt_state,
                                        put(toks[:, :-1]), put(toks[:, 1:]))
         losses.append(float(loss))
@@ -165,6 +171,15 @@ def test_pipeline_interleaved_schedule_matches_plain():
     non-contiguous layer chunks, micros ride the ring twice — same loss,
     v-fold smaller ramp waste."""
     _check_pipeline_matches_plain(make_mesh(8, pp=2, tp=2), n_chunks=2)
+
+
+def test_pipeline_composes_with_flash_attention():
+    """pp2 x tp2 with attn_impl="flash": stage bodies call the Pallas kernel
+    under auto_axes (S=128 so it tiles — shorter S falls back to dense).
+    First-step loss must match the plain non-pipelined dense path."""
+    _check_pipeline_matches_plain(make_mesh(8, pp=2, tp=2), n_chunks=1,
+                                  cfg=replace(CFG4, attn_impl="flash"),
+                                  batch=4, seq=128, steps=2)
 
 
 def test_pipeline_train_step_loss_decreases():
