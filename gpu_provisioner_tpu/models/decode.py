@@ -6,11 +6,13 @@ slices to serve models, so the framework ships the decode loop, TPU-first:
 - **one cached forward** serves both phases: prefill runs the whole prompt
   through it (S tokens, causal within the window, writing the cache),
   decode runs it with S=1 — no separate code paths to diverge;
-- **static shapes throughout**: the cache is a fixed [L, B, max_len, Hkv, Dh]
-  ring of buffers updated with ``lax.dynamic_update_slice``; attention
-  always scores against the full cache width with a length mask (no
-  data-dependent shapes, so XLA compiles exactly two programs: prefill and
-  decode step);
+- **static shapes throughout**: the cache is a fixed [L, B, Hkv, max_len, Dh]
+  ring of buffers updated with ``lax.dynamic_update_slice`` (head-major:
+  each head's sequence is contiguous, so the flash prefill kernel views it
+  as [B·Hkv, max_len, Dh] with a FREE reshape — no transposed copy of the
+  cache is ever materialized); attention scores against the full cache
+  width with a length mask (no data-dependent shapes, so XLA compiles
+  exactly two programs: prefill and decode step);
 - **generate is one ``lax.scan``** over decode steps — the whole
   autoregressive loop is a single compiled program, no host round-trips
   per token;
@@ -40,13 +42,13 @@ NEG_INF = -1.0e30
 
 
 class KVCache(NamedTuple):
-    k: jax.Array        # [L, B, max_len, Hkv, Dh]
-    v: jax.Array        # [L, B, max_len, Hkv, Dh]
+    k: jax.Array        # [L, B, Hkv, max_len, Dh] (head-major — see module doc)
+    v: jax.Array        # [L, B, Hkv, max_len, Dh]
     length: jax.Array   # scalar int32 — tokens written so far
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, cfg.act_dtype),
                    v=jnp.zeros(shape, cfg.act_dtype),
                    length=jnp.zeros((), jnp.int32))
@@ -55,7 +57,7 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
 def kv_cache_specs(cfg: LlamaConfig) -> KVCache:
     """PartitionSpecs mirroring the attention weights' tp layout (kv heads
     over ``model``) so the cache shards with the model."""
-    spec = P(None, None, None, AXIS_MODEL, None)
+    spec = P(None, None, AXIS_MODEL, None, None)
     return KVCache(k=spec, v=spec, length=P())
 
 
@@ -71,25 +73,27 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense"):
     — blocks past the causal frontier are neither computed nor DMA'd, so
     continuing a partially-filled cache stops paying the dense S×max_len
     sweep. S=1 decode steps always use the dense path (a GEMV-shaped op the
-    kernel can't tile)."""
+    kernel can't tile).
+
+    k_cache/v_cache: [B, Hkv, max_len, Dh] head-major (one layer's slice)."""
     B, S, Hq, Dh = q.shape
+    Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
     if impl == "flash":
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
-        if cached_flash_supported(S, k_cache.shape[1], Hq, k_cache.shape[2]):
+        if cached_flash_supported(S, max_len, Hq, Hkv):
             return flash_attention_cached(q, k_cache, v_cache, start,
                                           scale=scale)
-    max_len, Hkv = k_cache.shape[1], k_cache.shape[2]
     group = Hq // Hkv
     qg = q.reshape(B, S, Hkv, group, Dh)
-    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+    s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
     key_pos = jnp.arange(max_len)                      # [K]
     q_pos = start + jnp.arange(S)                      # [S]
     mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
     s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache.astype(jnp.float32))
+    o = jnp.einsum("bhgqk,bhkd->bqhgd", p, v_cache.astype(jnp.float32))
     return o.reshape(B, S, Hq, Dh).astype(q.dtype)
 
 
@@ -119,8 +123,12 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
         a = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
         q, k, v = _project_qkv(a, lp, cfg, positions)
 
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+        # new tokens arrive token-major [B, S, Hkv, Dh]; the head-major
+        # transpose is O(S) — tiny next to the cache it writes into
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.transpose(0, 2, 1, 3), (0, 0, start, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.transpose(0, 2, 1, 3), (0, 0, start, 0))
 
         o = _cached_attention(q, k_cache, v_cache, start, scale,
                               impl=cfg.attn_impl)
@@ -160,7 +168,11 @@ def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
     x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
 
-    pad = [(0, 0), (0, 0), (0, max_len - S), (0, 0), (0, 0)]
+    # scan stacks token-major [L, B, S, Hkv, Dh]; one O(S)-sized transpose
+    # to head-major, then pad the sequence dim out to max_len
+    ks = ks.transpose(0, 1, 3, 2, 4)
+    vs = vs.transpose(0, 1, 3, 2, 4)
+    pad = [(0, 0), (0, 0), (0, 0), (0, max_len - S), (0, 0)]
     cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
                     length=jnp.asarray(S, jnp.int32))
     return logits, cache
@@ -174,23 +186,55 @@ def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
     for continuing a partially-filled cache."""
     if fresh:
         logits, cache = _prefill_forward(params, prompt,
-                                         cache.k.shape[2], cfg)
+                                         cache.k.shape[3], cfg)
     else:
         logits, cache = cached_forward(params, prompt, cache, cfg)
     return logits[:, -1], cache
 
 
+def _filter_top_k(logits, top_k: int):
+    """Keep the k highest logits per row; the rest → -inf."""
+    vals = jax.lax.top_k(logits, top_k)[0]
+    return jnp.where(logits >= vals[..., -1:], logits, NEG_INF)
+
+
+def _filter_top_p(logits, top_p: float):
+    """Nucleus filter: keep the smallest set of tokens whose probability
+    mass reaches ``top_p`` (always ≥1 token — the exclusive cumsum keeps
+    the top token even when its own mass exceeds top_p)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    exclusive_csum = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    keep = exclusive_csum < top_p
+    # per-row probability threshold = smallest kept prob (2.0 > any prob)
+    thresh = jnp.min(jnp.where(keep, sorted_probs, 2.0), axis=-1,
+                     keepdims=True)
+    return jnp.where(probs >= thresh, logits, NEG_INF)
+
+
 def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
-             max_len: int = None, temperature: float = 0.0, key=None):
+             max_len: int = None, temperature: float = 0.0,
+             top_k: int = None, top_p: float = None, key=None):
     """Autoregressive generation: prefill, then ONE lax.scan of decode
     steps. prompt: [B, S0] int32 → [B, max_new_tokens] int32.
-    temperature 0 = greedy; otherwise pass ``key`` for sampling."""
+
+    temperature 0 = greedy (top_k/top_p ignored). temperature > 0 samples
+    — ``key`` is then REQUIRED (a silent default key would make "sampled"
+    serving output deterministic across calls; same required-argument
+    rationale as restore_train_state's optimizer). Filters compose in the
+    standard serving order: temperature → top_k → top_p → categorical."""
     B, S0 = prompt.shape
     if max_len is None:
         max_len = S0 + max_new_tokens
     assert S0 + max_new_tokens <= max_len, (S0, max_new_tokens, max_len)
     if temperature > 0 and key is None:
-        key = jax.random.key(0)
+        raise ValueError(
+            "generate(temperature>0) requires an explicit PRNG key — "
+            "sampling without one would be silently deterministic")
+    if top_k is not None and not 0 < top_k:
+        raise ValueError(f"top_k must be positive, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     cache = init_kv_cache(cfg, B, max_len)
     logits, cache = prefill(params, prompt, cache, cfg, fresh=True)
@@ -198,8 +242,12 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     def pick(logits, key):
         if temperature <= 0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            logits = _filter_top_k(logits, top_k)
+        if top_p is not None:
+            logits = _filter_top_p(logits, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     keys = (jax.random.split(key, max_new_tokens) if temperature > 0
             else jnp.zeros((max_new_tokens,)))

@@ -361,9 +361,14 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     models/decode.py:_cached_attention when shapes tile.
 
     q: [B, S, Hq, D] queries at cache positions start..start+S-1;
-    k_cache/v_cache: [B, max_len, Hkv, D] with those positions already
-    written; ``start``: traced int32 scalar. Returns [B, S, Hq, D].
-    Callers must gate on cached_flash_supported().
+    k_cache/v_cache: [B, Hkv, max_len, D] HEAD-MAJOR (models/decode.py's
+    cache layout — each head's sequence contiguous, so the kernel's
+    [B·Hkv, max_len, D] view is a free reshape; a token-major cache would
+    force a transposed HBM copy of the whole cache per call, costing
+    O(max_len) where this path is meant to cost O(written prefix)) with
+    positions start..start+S-1 already written; ``start``: traced int32
+    scalar. Returns [B, S, Hq, D]. Callers must gate on
+    cached_flash_supported().
 
     Sharding note: under a tensor-parallel mesh the GSPMD partitioner cannot
     split a pallas_call, so a kv-head-sharded cache is gathered around the
@@ -373,7 +378,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     serving (today's deployment shape) pays nothing; a shard_map'd serving
     wrapper is the follow-up if tp serving at large max_len becomes real."""
     B, S, Hq, D = q.shape
-    ML, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, ML = k_cache.shape[1], k_cache.shape[2]
     group = Hq // Hkv
     if scale is None:
         scale = D ** -0.5
@@ -382,8 +387,9 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
 
-    qf = _heads_to_rows(q)
-    kf, vf = _heads_to_rows(k_cache), _heads_to_rows(v_cache)
+    qf = _heads_to_rows(q)                      # O(S) transpose — tiny
+    kf = k_cache.reshape(B * Hkv, ML, D)        # head-major: free reshape
+    vf = v_cache.reshape(B * Hkv, ML, D)
     start_arr = jnp.asarray(start, jnp.int32).reshape(1)
 
     def q_idx(bh, qi, kj, start_ref):

@@ -91,8 +91,8 @@ def test_cached_flash_matches_dense_masked_sweep(start):
     B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
     ks = jax.random.split(jax.random.key(3), 3)
     q = jax.random.normal(ks[0], (B, S, Hq, D))
-    k_cache = jax.random.normal(ks[1], (B, ML, Hkv, D))
-    v_cache = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    k_cache = jax.random.normal(ks[1], (B, Hkv, ML, D))   # head-major
+    v_cache = jax.random.normal(ks[2], (B, Hkv, ML, D))
     assert cached_flash_supported(S, ML, Hq, Hkv)
     scale = D ** -0.5
     start = jnp.asarray(start, jnp.int32)
@@ -110,8 +110,8 @@ def test_cached_flash_under_jit_traced_start():
     B, S, ML, Hq, Hkv, D = 1, 128, 256, 2, 1, 32
     ks = jax.random.split(jax.random.key(4), 3)
     q = jax.random.normal(ks[0], (B, S, Hq, D))
-    kc = jax.random.normal(ks[1], (B, ML, Hkv, D))
-    vc = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))        # head-major
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
     f = jax.jit(lambda s: flash_attention_cached(q, kc, vc, s))
     for s in (0, 65, 128):
         ref = _cached_attention(q, kc, vc, jnp.asarray(s), D ** -0.5)
