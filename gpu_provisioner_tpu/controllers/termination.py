@@ -108,6 +108,13 @@ class EvictionQueue:
     async def _warn_blocked(self, pod: Pod, err: Exception, fails: int) -> None:
         if self.recorder is None or fails < self.WARN_AFTER:
             return
+        # Warn at the threshold, then on a doubling schedule (3, 6, 12, 24
+        # attempts, ...): a long-blocked drain stays visible in events
+        # without paying the recorder's get+update apiserver round-trip on
+        # every ~10s capped-delay retry for the whole blocked duration.
+        times_over, rem = divmod(fails, self.WARN_AFTER)
+        if rem or (times_over & (times_over - 1)):
+            return
         await self.recorder.publish(
             pod, WARNING, "FailedDraining",
             f"Failed to evict pod after {fails} attempts: {err}")

@@ -14,9 +14,12 @@ module gives the flagship train loop crash-consistent save/restore:
   a tp-heavy one (or a different slice count after repair) with orbax doing
   the resharding — exactly the elastic-recovery story the provisioner's
   repair loop implies;
-- the on-disk tree is the logical layer order: pipeline layouts
-  (to_pipeline_layout's interleave) must be applied AFTER restore, keeping
-  checkpoints schedule-agnostic.
+- the on-disk tree SHOULD be the logical layer order (schedule-agnostic);
+  states built by make_pipeline_train_state carry interleaved blocks, so
+  every checkpoint records its ``(n_stages, n_chunks)`` layout and restore
+  REFUSES a layout mismatch — a silent mismatch would permute layers.
+  Convert with parallel.pipeline.from_pipeline_layout /
+  to_pipeline_layout when moving a checkpoint between geometries.
 """
 
 from __future__ import annotations
@@ -28,12 +31,25 @@ from jax.sharding import NamedSharding
 from .llama import LlamaConfig, init_params, param_specs
 
 
-def save_train_state(path, params, opt_state, step: int) -> None:
-    """Write {params, opt_state, step} atomically (temp dir + rename, which
-    orbax does internally — a killed save never corrupts the previous one)."""
+def _layout_entry(n_stages: int, n_chunks: int) -> dict:
+    return {"n_stages": int(n_stages), "n_chunks": int(n_chunks)}
+
+
+def save_train_state(path, params, opt_state, step: int, *,
+                     n_stages: int = 1, n_chunks: int = 1) -> None:
+    """Write {params, opt_state, step, layout} atomically (temp dir +
+    rename, which orbax does internally — a killed save never corrupts the
+    previous one).
+
+    ``n_stages``/``n_chunks``: the pipeline storage layout of
+    params["blocks"] (1/1 = logical layer order). States from
+    make_pipeline_train_state are interleaved (to_pipeline_layout) and MUST
+    be stamped with their geometry — restore fails loudly on mismatch
+    instead of silently permuting layers."""
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(str(path), {"params": params, "opt_state": opt_state,
-                               "step": step})
+                               "step": step,
+                               "layout": _layout_entry(n_stages, n_chunks)})
 
 
 def _abstract_target(mesh, cfg: LlamaConfig, optimizer, specs=None) -> dict:
@@ -64,10 +80,24 @@ def _abstract_target(mesh, cfg: LlamaConfig, optimizer, specs=None) -> dict:
                                             sharding=_on_mesh(sh)),
         jax.eval_shape(optimizer.init, abstract_params),
         compiled_init.output_shardings)
-    return {"params": abstract_params, "opt_state": abstract_opt, "step": 0}
+    return {"params": abstract_params, "opt_state": abstract_opt, "step": 0,
+            "layout": _layout_entry(1, 1)}
 
 
-def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
+def _check_layout(restored: dict, n_stages: int, n_chunks: int) -> None:
+    got = restored.get("layout", _layout_entry(1, 1))
+    want = _layout_entry(n_stages, n_chunks)
+    if got != want:
+        raise ValueError(
+            f"checkpoint blocks are in pipeline layout {got}, but restore "
+            f"expected {want} — restoring across layouts silently permutes "
+            "layers. Convert with parallel.pipeline.from_pipeline_layout / "
+            "to_pipeline_layout, or restore with the matching "
+            "n_stages/n_chunks.")
+
+
+def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None,
+                        *, n_stages: int = 1, n_chunks: int = 1):
     """(params, opt_state, step) restored ONTO ``mesh`` — target shardings
     derive from the current mesh/specs, not whatever mesh wrote the
     checkpoint, so restore doubles as reshard.
@@ -75,10 +105,22 @@ def restore_train_state(path, mesh, cfg: LlamaConfig, optimizer, specs=None):
     ``optimizer`` is required, not defaulted: the abstract opt-state target
     (shapes AND dtypes) comes from it, and orbax casts stored leaves to the
     target dtype without complaint — restoring a bf16-mu checkpoint through
-    an f32-mu default would silently diverge from the uninterrupted run."""
+    an f32-mu default would silently diverge from the uninterrupted run.
+
+    ``n_stages``/``n_chunks`` must match the layout stamped at save time
+    (ValueError otherwise — a mismatch would permute layers). Checkpoints
+    written before layout stamping restore as logical order (1, 1)."""
     target = _abstract_target(mesh, cfg, optimizer, specs)
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(str(path), target)
+        try:
+            restored = ckptr.restore(str(path), target)
+        except ValueError:
+            # pre-layout checkpoint: orbax refuses a target tree with a key
+            # the file lacks; retry without it (a genuinely different
+            # mismatch fails again here, with the real error)
+            target.pop("layout")
+            restored = ckptr.restore(str(path), target)
+    _check_layout(restored, n_stages, n_chunks)
     return restored["params"], restored["opt_state"], int(restored["step"])
 
 
@@ -95,12 +137,15 @@ class TrainCheckpointManager:
 
     def __init__(self, directory, mesh, cfg: LlamaConfig, optimizer,
                  specs=None, max_to_keep: int = 3,
-                 save_interval_steps: int = 100):
+                 save_interval_steps: int = 100,
+                 n_stages: int = 1, n_chunks: int = 1):
         self.directory = str(directory)
         self.mesh = mesh
         self.cfg = cfg
         self.optimizer = optimizer
         self.specs = specs
+        self.n_stages = n_stages
+        self.n_chunks = n_chunks
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -111,7 +156,8 @@ class TrainCheckpointManager:
         """Save iff the schedule says so; returns whether a save happened."""
         return self._mgr.save(
             step, args=ocp.args.StandardSave(
-                {"params": params, "opt_state": opt_state, "step": step}))
+                {"params": params, "opt_state": opt_state, "step": step,
+                 "layout": _layout_entry(self.n_stages, self.n_chunks)}))
 
     def latest_step(self):
         return self._mgr.latest_step()
@@ -132,10 +178,17 @@ class TrainCheckpointManager:
             return None
         # restore THROUGH the manager (not a hand-built path — the step
         # directory layout is orbax's own convention)
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(
-                _abstract_target(self.mesh, self.cfg, self.optimizer,
-                                 self.specs)))
+        target = _abstract_target(self.mesh, self.cfg, self.optimizer,
+                                  self.specs)
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+        except ValueError:
+            # pre-layout checkpoint (see restore_train_state)
+            target.pop("layout")
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+        _check_layout(restored, self.n_stages, self.n_chunks)
         return (restored["params"], restored["opt_state"],
                 int(restored["step"]))
 

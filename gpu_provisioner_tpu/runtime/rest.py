@@ -368,6 +368,12 @@ class RestWatch:
         self.cls = cls
         self._q: asyncio.Queue = asyncio.Queue()
         self._closed = False
+        # keys this watch has told consumers exist — so a re-list after an
+        # outage can synthesize DELETED tombstones for objects that vanished
+        # while the stream was down (client-go reflector Replace() parity;
+        # without it a cache layered on this watch holds deleted objects
+        # until its own resync)
+        self._known: set[tuple[str, str]] = set()
         self._task = asyncio.ensure_future(self._run())
 
     def __aiter__(self):
@@ -410,10 +416,19 @@ class RestWatch:
 
     async def _list_into_queue(self) -> str:
         rv = ""
+        fresh: set[tuple[str, str]] = set()
         async for body in self.client.list_pages(self.cls):
             for item in body.get("items", []):
-                self._q.put_nowait(WatchEvent(ADDED, self.cls.from_dict(item)))
+                obj = self.cls.from_dict(item)
+                fresh.add((obj.metadata.namespace, obj.metadata.name))
+                self._q.put_nowait(WatchEvent(ADDED, obj))
             rv = body.get("metadata", {}).get("resourceVersion", "") or rv
+        for ns, name in self._known - fresh:
+            # tombstone: a metadata-only object — consumers key caches and
+            # workqueues off (namespace, name), which is all it carries
+            self._q.put_nowait(WatchEvent(DELETED, self.cls.from_dict(
+                {"metadata": {"name": name, "namespace": ns}})))
+        self._known = fresh
         return rv
 
     async def _stream(self, rv: str) -> str:
@@ -444,7 +459,12 @@ class RestWatch:
                 raw.setdefault("kind", self.cls.KIND)
                 raw.setdefault("apiVersion", self.cls.API_VERSION)
                 if etype in (ADDED, MODIFIED, DELETED):
-                    self._q.put_nowait(
-                        WatchEvent(etype, self.cls.from_dict(raw)))
+                    obj = self.cls.from_dict(raw)
+                    key = (obj.metadata.namespace, obj.metadata.name)
+                    if etype == DELETED:
+                        self._known.discard(key)
+                    else:
+                        self._known.add(key)
+                    self._q.put_nowait(WatchEvent(etype, obj))
                 rv = new_rv or rv
         return rv

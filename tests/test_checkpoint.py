@@ -53,6 +53,44 @@ def test_checkpoint_roundtrip_and_cross_mesh_restore(tmp_path):
                                atol=2e-2, rtol=2e-3)  # bf16 reduction order
 
 
+def test_checkpoint_refuses_pipeline_layout_mismatch(tmp_path):
+    """A checkpoint stamped with an interleaved pipeline layout must not
+    restore through a logical-order (or different-geometry) target — that
+    would silently permute layers (ADVICE r3)."""
+    import pytest
+
+    opt = default_optimizer()
+    mesh = make_mesh(8)
+    params, opt_state, _ = make_train_state(jax.random.key(0), CFG, mesh,
+                                            optimizer=opt)
+    save_train_state(tmp_path / "ckpt", params, opt_state, step=3,
+                     n_stages=2, n_chunks=2)
+    with pytest.raises(ValueError, match="pipeline layout"):
+        restore_train_state(tmp_path / "ckpt", mesh, CFG, opt)
+    _, _, step = restore_train_state(tmp_path / "ckpt", mesh, CFG, opt,
+                                     n_stages=2, n_chunks=2)
+    assert step == 3
+
+
+def test_checkpoint_restores_pre_layout_format(tmp_path):
+    """A checkpoint written before layout stamping (no 'layout' entry, e.g.
+    round-3 artifacts) must still restore, defaulting to logical order."""
+    import orbax.checkpoint as ocp
+
+    opt = default_optimizer()
+    mesh = make_mesh(8)
+    params, opt_state, _ = make_train_state(jax.random.key(0), CFG, mesh,
+                                            optimizer=opt)
+    with ocp.StandardCheckpointer() as ckptr:       # legacy save format
+        ckptr.save(str(tmp_path / "old"), {"params": params,
+                                           "opt_state": opt_state, "step": 5})
+    r_params, _, step = restore_train_state(tmp_path / "old", mesh, CFG, opt)
+    assert step == 5
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(r_params),
+                    strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_checkpoint_manager_rotates_and_resumes(tmp_path):
     opt = default_optimizer()
     mesh = make_mesh(8)

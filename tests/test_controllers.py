@@ -365,6 +365,28 @@ async def test_in_memory_evict_honors_pdb():
 
 
 @async_test
+async def test_blocked_eviction_warning_throttles_to_doubling_schedule():
+    """After WARN_AFTER the Warning repeats only on a doubling schedule
+    (3, 6, 12, 24 attempts) — not on every ~10s capped-delay retry, which
+    would cost the recorder an apiserver round-trip each time (ADVICE r3)."""
+    from gpu_provisioner_tpu.controllers.termination import EvictionQueue
+
+    published = []
+
+    class Rec:
+        async def publish(self, obj, type_, reason, msg):
+            published.append(msg)
+
+    q = EvictionQueue(client=None, recorder=Rec())
+    pod = _workload_pod()
+    for fails in range(1, 25):
+        await q._warn_blocked(pod, RuntimeError("pdb"), fails)
+    assert len(published) == 4 and "after 3 attempts" in published[0]
+    assert [int(m.split("after ")[1].split(" ")[0]) for m in published] \
+        == [3, 6, 12, 24]
+
+
+@async_test
 async def test_blocked_eviction_warns_then_drains_when_pdb_lifted():
     """A PDB-blocked drain retries with backoff, surfaces a Warning event on
     the pod once the blockage persists (eviction.go:199-207 analog), and

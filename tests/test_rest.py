@@ -25,7 +25,7 @@ from gpu_provisioner_tpu.runtime.client import (AlreadyExistsError,
                                                 NotFoundError)
 from gpu_provisioner_tpu.runtime.rest import (KubeConnection, RestClient,
                                               resource_path)
-from gpu_provisioner_tpu.runtime.store import ADDED, MODIFIED
+from gpu_provisioner_tpu.runtime.store import ADDED, DELETED, MODIFIED
 from gpu_provisioner_tpu.transport import TransportOptions, request_with_retries
 
 from .conftest import async_test
@@ -169,6 +169,39 @@ async def test_kube_watch_replays_then_streams():
     w.close()
     with pytest.raises(StopAsyncIteration):
         await w.__anext__()
+
+
+@async_test
+async def test_kube_watch_synthesizes_delete_tombstones_on_relist():
+    """Objects that vanish while the watch stream is down must come back as
+    DELETED tombstones when the re-list replays (client-go reflector
+    Replace() parity) — otherwise informer caches hold them until resync."""
+    item = lambda n, rv: {"kind": "NodeClaim",
+                          "apiVersion": "karpenter.sh/v1",
+                          "metadata": {"name": n, "resourceVersion": rv}}
+    state = {"lists": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.params.get("watch") == "true":
+            # every stream dies with 410 Gone → re-list path
+            return httpx.Response(410, text="gone")
+        state["lists"] += 1
+        if state["lists"] == 1:
+            return httpx.Response(200, json={
+                "items": [item("a", "1"), item("b", "2")],
+                "metadata": {"resourceVersion": "5"}})
+        return httpx.Response(200, json={      # "b" deleted during outage
+            "items": [item("a", "1")], "metadata": {"resourceVersion": "7"}})
+
+    c = make_kube_client(handler)
+    w = c.watch(NodeClaim)
+    evs = [await asyncio.wait_for(w.__anext__(), 5) for _ in range(4)]
+    w.close()
+    assert [(e.type, e.object.metadata.name) for e in evs] == [
+        (ADDED, "a"), (ADDED, "b"),   # first list
+        (ADDED, "a"),                 # re-list replay after the 410
+        (DELETED, "b"),               # tombstone for the vanished object
+    ]
 
 
 # --- kubeconfig parsing ----------------------------------------------------
