@@ -50,6 +50,11 @@ class EnvtestOptions:
     repair_toleration: float = 30.0
     repair_max_unhealthy_fraction: float = 0.0
     max_concurrent_reconciles: int = 64
+    # Layer the informer cache between controllers/provider and the store,
+    # as the real operator wires it (__main__.py) — bench.py turns this on
+    # so fleet-scale runs exercise (and size) the cache; unit tests keep the
+    # raw client's read-your-writes simplicity.
+    use_informer: bool = False
 
 
 class Env:
@@ -67,8 +72,14 @@ class Env:
             node_join_delay=self.opts.node_join_delay,
             node_ready_delay=self.opts.node_ready_delay,
             qr_step_latency=self.opts.qr_step_latency)
+        kube = self.client
+        self.informers = None
+        if self.opts.use_informer:
+            from .runtime.informer import CachedListClient
+            kube = CachedListClient(self.client, (Node, NodeClaim))
+            self.informers = kube
         self.provider = InstanceProvider(
-            self.cloud.nodepools, self.client,
+            self.cloud.nodepools, kube,
             ProviderConfig(node_wait_interval=self.opts.node_wait_interval,
                            node_wait_attempts=self.opts.node_wait_attempts),
             queued=self.cloud.queuedresources)
@@ -76,7 +87,7 @@ class Env:
             self.provider, repair_toleration=self.opts.repair_toleration))
         self.recorder = Recorder(self.client)
         controllers, self.eviction = build_controllers(
-            self.client, self.cloudprovider, self.recorder,
+            kube, self.cloudprovider, self.recorder,
             lifecycle_options=self.opts.lifecycle,
             termination_options=self.opts.termination,
             gc_options=GCOptions(interval=self.opts.gc_interval,
@@ -87,6 +98,8 @@ class Env:
         self.manager = Manager(self.client).register(*controllers)
 
     async def __aenter__(self) -> "Env":
+        if self.informers is not None:
+            await self.informers.start()   # sync before the first reconcile
         self.eviction.start()
         await self.manager.start()
         return self
@@ -94,12 +107,25 @@ class Env:
     async def __aexit__(self, *exc) -> None:
         await self.manager.stop()
         await self.eviction.stop()
+        if self.informers is not None:
+            await self.informers.stop()
+
+    def informer_cache_sizes(self) -> dict[str, int]:
+        """Cached object count per kind (empty when informers are off) —
+        the bench reports this as the informer memory proxy."""
+        if self.informers is None:
+            return {}
+        return {cls.KIND: len(inf._cache)
+                for cls, inf in self.informers._informers.items()}
 
     # ------------------------------------------------------------- helpers
-    async def wait_ready(self, name: str, timeout: float = 10.0) -> NodeClaim:
-        """Block until the NodeClaim's Ready root condition is True."""
+    async def wait_ready(self, name: str, timeout: float = 10.0,
+                         poll: Optional[float] = None) -> NodeClaim:
+        """Block until the NodeClaim's Ready root condition is True.
+        ``poll`` fixes the polling interval — fleet-scale callers (bench)
+        pass ~0.25s so a thousand waiters don't open at 100 Hz each."""
         return await self._wait(name, lambda nc: nc.status_conditions.is_true(
-            CONDITION_READY), timeout, "Ready")
+            CONDITION_READY), timeout, "Ready", poll=poll)
 
     async def wait_gone(self, name: str, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -112,12 +138,13 @@ class Env:
                 raise TimeoutError(f"nodeclaim {name} still present after {timeout}s")
             await asyncio.sleep(0.01)
 
-    async def _wait(self, name: str, predicate, timeout: float, what: str) -> NodeClaim:
+    async def _wait(self, name: str, predicate, timeout: float, what: str,
+                    poll: Optional[float] = None) -> NodeClaim:
         deadline = asyncio.get_event_loop().time() + timeout
         last = None
-        interval = 0.01  # fast for unit-test latencies, backs off at fleet
-        while True:      # scale (hundreds of waiters × 100 Hz was real load)
-            last = await self.client.get(NodeClaim, name)
+        interval = poll or 0.01  # fast for unit-test latencies, backs off at
+        while True:              # fleet scale (hundreds of waiters × 100 Hz
+            last = await self.client.get(NodeClaim, name)  # was real load)
             if predicate(last):
                 return last
             if asyncio.get_event_loop().time() > deadline:

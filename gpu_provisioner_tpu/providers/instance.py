@@ -139,6 +139,10 @@ class ProviderConfig:
 class InstanceProvider:
     """Create/Get/List/Delete over the node-pool + queued-resource seams."""
 
+    # How long a pool-listing snapshot serves slice-group identity reads.
+    # Determinism makes the staleness safe (see _pools_snapshot).
+    POOL_SNAPSHOT_TTL = 1.0
+
     def __init__(self, nodepools: NodePoolsAPI, kube: Client,
                  config: Optional[ProviderConfig] = None,
                  queued: Optional[QueuedResourcesAPI] = None):
@@ -146,6 +150,30 @@ class InstanceProvider:
         self.queued = queued
         self.kube = kube
         self.cfg = config or ProviderConfig()
+        self._pool_snapshot: Optional[tuple[float, list[NodePool]]] = None
+        self._pool_snapshot_lock = asyncio.Lock()
+
+    async def _pools_snapshot(self) -> list[NodePool]:
+        """Pool listing for slice-group identity reads, memoized for
+        POOL_SNAPSHOT_TTL with single-flight: a concurrent wave of grouped
+        creates does ONE cloud LIST per burst instead of one per member
+        (O(groups·members) otherwise — the reference's 1000-concurrency
+        lifecycle regime would melt the API quota).
+
+        Staleness within the TTL is safe BECAUSE assignment is
+        deterministic: a member whose just-stamped pool is missing from the
+        snapshot is re-derived from the same (creationTimestamp, name)
+        NodeClaim order every racing reconciler uses, yielding the same
+        index (see _slice_group_identity). Stickiness only has to survive
+        restarts, which outlive any 1s snapshot."""
+        async with self._pool_snapshot_lock:
+            now_s = asyncio.get_event_loop().time()
+            if (self._pool_snapshot is not None
+                    and now_s - self._pool_snapshot[0] < self.POOL_SNAPSHOT_TTL):
+                return self._pool_snapshot[1]
+            pools = await self.nodepools.list()
+            self._pool_snapshot = (now_s, pools)
+            return pools
 
     # ------------------------------------------------------------- create
     async def create(self, nc: NodeClaim) -> Instance:
@@ -241,7 +269,7 @@ class InstanceProvider:
         if not group:
             return {}
 
-        pools = await self.nodepools.list()
+        pools = await self._pools_snapshot()
         used: dict[int, str] = {}          # stamped index -> pool name
         for p in pools:
             if p.config.labels.get(wk.TPU_SLICE_GROUP_LABEL) != group:

@@ -17,12 +17,16 @@ accepts stale reads everywhere and relies on watch latency being small).
 Staleness is bounded by watch delivery plus the periodic resync (a guard
 re-list reconciling missed events, like an informer's resync period). GC
 tolerates it by design — its 30s leak grace exceeds any realistic lag.
+Deletions missed during a watch-stream outage do NOT linger until resync:
+RestWatch replays its re-list with synthesized DELETED tombstones for
+objects that vanished while the stream was down (client-go reflector
+Replace() parity — see rest.py), so the cache converges as soon as the
+watch self-heals.
 """
 
 from __future__ import annotations
 
 import asyncio
-import copy
 import logging
 from typing import Optional
 
@@ -45,11 +49,35 @@ class Informer:
         self.resync = resync
         self.synced = False
         self._cache: dict[tuple[str, str], Object] = {}
+        # label inverted index, mirroring the store's (store.py _by_label):
+        # per-pool node lists at fleet scale must be O(result), not
+        # O(cache) — a linear items() scan under hundreds of concurrent
+        # node-waits melted the event loop at 512+ claims
+        self._by_label: dict[tuple[str, str], set] = {}
         self._task: Optional[asyncio.Task] = None
 
     @staticmethod
     def _key(obj: Object) -> tuple[str, str]:
         return (obj.metadata.namespace, obj.metadata.name)
+
+    def _upsert(self, obj: Object) -> None:
+        key = self._key(obj)
+        old = self._cache.get(key)
+        if old is not None:
+            self._unindex(key, old)
+        self._cache[key] = obj
+        for lk_lv in obj.metadata.labels.items():
+            self._by_label.setdefault(lk_lv, set()).add(key)
+
+    def _remove(self, obj: Object) -> None:
+        key = self._key(obj)
+        old = self._cache.pop(key, None)
+        if old is not None:
+            self._unindex(key, old)
+
+    def _unindex(self, key, obj: Object) -> None:
+        for lk_lv in obj.metadata.labels.items():
+            self._by_label.get(lk_lv, set()).discard(key)
 
     async def start(self) -> None:
         if self._task is not None:
@@ -81,8 +109,11 @@ class Informer:
         self.synced = False
 
     async def _relist(self) -> None:
-        fresh = {self._key(o): o for o in await self.client.list(self.cls)}
-        self._cache = fresh
+        objs = await self.client.list(self.cls)
+        self._cache = {}
+        self._by_label = {}
+        for o in objs:
+            self._upsert(o)
 
     async def _run(self) -> None:
         watch = self._watch
@@ -104,9 +135,9 @@ class Informer:
                     except (asyncio.TimeoutError, StopAsyncIteration):
                         break
                     if ev.type == DELETED:
-                        self._cache.pop(self._key(ev.object), None)
+                        self._remove(ev.object)
                     else:
-                        self._cache[self._key(ev.object)] = ev.object
+                        self._upsert(ev.object)
             except asyncio.CancelledError:
                 watch.close()
                 raise
@@ -128,9 +159,17 @@ class Informer:
               index_fn=None, index_value=None) -> list[Object]:
         """Cache snapshot with the same filter semantics as Client.list.
         Deep copies — callers mutate their listed objects freely (the
-        controllers do) and must never write through into the cache."""
+        controllers do) and must never write through into the cache.
+        Label queries narrow through the inverted index first (O(result))."""
+        if labels:
+            lk, lv = next(iter(labels.items()))
+            keys = self._by_label.get((lk, lv), set())
+            candidates = [(k, self._cache[k]) for k in list(keys)
+                          if k in self._cache]
+        else:
+            candidates = list(self._cache.items())
         out = []
-        for (ns, _), obj in self._cache.items():
+        for (ns, _), obj in candidates:
             if namespace is not None and ns != namespace:
                 continue
             if labels and any(obj.metadata.labels.get(k) != v
@@ -138,7 +177,10 @@ class Informer:
                 continue
             if index_fn is not None and index_value not in index_fn(obj):
                 continue
-            out.append(copy.deepcopy(obj))
+            # Object.deepcopy is the schema-aware fast clone (meta.py) —
+            # generic copy.deepcopy was ~10× slower and this is the bench
+            # hot path at fleet scale
+            out.append(obj.deepcopy())
         return out
 
 
