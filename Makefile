@@ -52,6 +52,19 @@ docker-build: ## Build the controller image
 docker-push: docker-build ## Push the controller image
 	docker push $(IMG_REPO):$(VERSION)
 
+# Multi-arch release image via buildx, mirroring the reference's
+# docker-build-kaito (reference Makefile:134-160: buildx create + multi
+# --platform build --push). amd64 for GKE nodes, arm64 for t2a/dev laptops.
+PLATFORMS ?= linux/amd64,linux/arm64
+BUILDER   ?= tpu-provisioner-builder
+
+.PHONY: docker-buildx
+docker-buildx: ## Build+push the multi-arch controller image manifest
+	-docker buildx create --name $(BUILDER) --use
+	docker buildx build --platform $(PLATFORMS) \
+	  -t $(IMG_REPO):$(VERSION) --push .
+	docker buildx rm $(BUILDER)
+
 ## -------- GKE cluster bootstrap (az-mkaks analog, Makefile:63-118) --------
 
 .PHONY: gke-mkcluster

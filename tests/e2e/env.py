@@ -64,6 +64,56 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+async def connect_real(env: Optional[dict] = None,
+                       kubeconfig: Optional[str] = None):
+    """The real-target connection path: kube client from a kubeconfig
+    (token, client-cert, or exec-plugin auth), production GKE client from
+    PROJECT_ID/LOCATION/CLUSTER_NAME (+ optional endpoint override), and
+    the CRD-served readiness gate. Shared by E2E_TARGET=real and the local
+    conformance suite (test_real_conformance.py), which points it at the
+    fake apiserver/GCP facade so these branches run on every push instead
+    of staying dead until someone has GKE credentials."""
+    from gpu_provisioner_tpu.auth.config import build_config
+    from gpu_provisioner_tpu.auth.credentials import new_credential
+    from gpu_provisioner_tpu.providers import rest as gcprest
+
+    client = RestClient(KubeConnection.from_kubeconfig(kubeconfig))
+    cfg = build_config(env)
+    nodepools = gcprest.GKENodePoolsClient(
+        new_credential(cfg), cfg.project_id, cfg.location, cfg.cluster_name,
+        endpoint=cfg.gke_api_endpoint or gcprest.GKE_ENDPOINT)
+    # readiness gate: apiserver reachable + NodeClaim CRD served (the
+    # reference's readyz checks CRD presence, operator.go:207-224)
+    await client.list(NodeClaim)
+    return client, nodepools
+
+
+async def discovery_teardown(client, eventually,
+                             timeout: float = DEFAULT_TIMEOUT) -> None:
+    """Delete every test-labeled object in parallel and wait for the
+    controllers to unwind the claims (setup.go:58-89's 50-worker cleanup)."""
+    from gpu_provisioner_tpu.apis.kaito import KaitoNodeClass
+
+    selector = {wk.DISCOVERY_LABEL: wk.DISCOVERY_VALUE}
+
+    async def _delete(cls: type, name: str) -> None:
+        try:
+            await client.delete(cls, name)
+        except NotFoundError:
+            pass
+
+    deletes = [(NodeClaim, c.metadata.name)
+               for c in await client.list(NodeClaim, labels=selector)]
+    deletes += [(KaitoNodeClass, k.metadata.name)
+                for k in await client.list(KaitoNodeClass, labels=selector)]
+    await asyncio.gather(*(_delete(cls, name) for cls, name in deletes))
+
+    async def all_gone():
+        left = await client.list(NodeClaim, labels=selector)
+        return not left or None
+    await eventually(all_gone, timeout=timeout, what="e2e NodeClaims cleaned up")
+
+
 class Environment:
     def __init__(self, tmp_path, *, gc_interval: float = 1.0,
                  leak_grace: float = 1.0, extra_env: Optional[dict] = None,
@@ -95,6 +145,7 @@ class Environment:
             return await self._enter_real()
         kube_url = await self.kube_server.start()
         gcp_url = await self.gcp_server.start()
+        self.kube_url, self.gcp_url = kube_url, gcp_url
 
         kubeconfig = self.tmp_path / "kubeconfig"
         kubeconfig.write_text(yaml.safe_dump({
@@ -147,47 +198,12 @@ class Environment:
     async def _enter_real(self) -> "Environment":
         """Target a live cluster: kubeconfig client + production GKE client;
         the operator must already be running in-cluster (helm chart)."""
-        from gpu_provisioner_tpu.auth.config import build_config
-        from gpu_provisioner_tpu.auth.credentials import new_credential
-        from gpu_provisioner_tpu.providers import rest as gcprest
-
-        self.client = RestClient(KubeConnection.from_kubeconfig())
-        cfg = build_config()
-        self.nodepools = gcprest.GKENodePoolsClient(
-            new_credential(cfg), cfg.project_id, cfg.location,
-            cfg.cluster_name,
-            endpoint=cfg.gke_api_endpoint or gcprest.GKE_ENDPOINT)
-        # readiness gate: apiserver reachable + NodeClaim CRD served (the
-        # reference's readyz checks CRD presence, operator.go:207-224)
-        await self.client.list(NodeClaim)
+        self.client, self.nodepools = await connect_real()
         return self
 
     async def _cleanup_real(self) -> None:
-        """Delete every test-labeled object in parallel and wait for the
-        controllers to unwind the claims (setup.go:58-89's 50-worker
-        cleanup)."""
-        from gpu_provisioner_tpu.apis.kaito import KaitoNodeClass
-
-        selector = {wk.DISCOVERY_LABEL: wk.DISCOVERY_VALUE}
-
-        async def _delete(cls: type, name: str) -> None:
-            try:
-                await self.client.delete(cls, name)
-            except NotFoundError:
-                pass
-
-        deletes = [(NodeClaim, c.metadata.name)
-                   for c in await self.client.list(NodeClaim, labels=selector)]
-        deletes += [(KaitoNodeClass, k.metadata.name)
-                    for k in await self.client.list(KaitoNodeClass,
-                                                    labels=selector)]
-        await asyncio.gather(*(_delete(cls, name) for cls, name in deletes))
-
-        async def all_gone():
-            left = await self.client.list(NodeClaim, labels=selector)
-            return not left or None
-        await self.eventually(all_gone, timeout=DEFAULT_TIMEOUT,
-                              what="e2e NodeClaims cleaned up")
+        await discovery_teardown(self.client, self.eventually,
+                                 DEFAULT_TIMEOUT)
 
     async def _pump_logs(self) -> None:
         assert self.proc and self.proc.stdout

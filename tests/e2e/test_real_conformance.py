@@ -1,0 +1,102 @@
+"""Real-mode branch conformance against local fakes (VERDICT r3 item 4).
+
+``E2E_TARGET=real``'s code paths — kubeconfig parsing with token AND
+exec-plugin auth, the production GKE REST client with endpoint override,
+the CRD-served readiness gate, and the discovery-label teardown — run here
+against the local fake apiserver + GCP facade on every push, instead of
+staying dead until someone has GKE credentials. The real-target analog is
+the reference's live suite bootstrap (suite_test.go:34-45 + setup.go:58-89);
+this file is the conformance harness that keeps those branches honest
+without a cluster.
+"""
+
+import json
+import sys
+
+import pytest
+import yaml
+
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+from gpu_provisioner_tpu.fake import make_nodeclaim
+
+from ..conftest import async_test_long as async_test
+from .env import Environment, connect_real, discovery_teardown, fake_only
+
+pytestmark = pytest.mark.e2e
+
+
+def _exec_kubeconfig(tmp_path, server: str) -> str:
+    """Kubeconfig whose user authenticates via a client-go exec credential
+    plugin (the shape `gcloud container clusters get-credentials` writes) —
+    the plugin is a tiny script printing an ExecCredential with the fake
+    apiserver's static token, plus an env-passthrough assertion."""
+    plugin = tmp_path / "fake-auth-plugin.py"
+    plugin.write_text(
+        "import json, os, sys\n"
+        "assert os.environ.get('CONFORMANCE_MARK') == '1', 'exec env lost'\n"
+        "json.dump({'apiVersion': 'client.authentication.k8s.io/v1',\n"
+        "           'kind': 'ExecCredential',\n"
+        "           'status': {'token': 'e2e-token'}}, sys.stdout)\n")
+    kc = tmp_path / "kubeconfig-exec"
+    kc.write_text(yaml.safe_dump({
+        "current-context": "e2e",
+        "contexts": [{"name": "e2e",
+                      "context": {"cluster": "e2e", "user": "e2e"}}],
+        "clusters": [{"name": "e2e", "cluster": {"server": server}}],
+        "users": [{"name": "e2e", "user": {"exec": {
+            "apiVersion": "client.authentication.k8s.io/v1",
+            "command": sys.executable,
+            "args": [str(plugin)],
+            "env": [{"name": "CONFORMANCE_MARK", "value": "1"}],
+        }}}],
+    }))
+    return str(kc)
+
+
+@fake_only
+@pytest.mark.parametrize("auth", ["token", "exec"])
+@async_test
+async def test_real_mode_branches_against_local_fakes(tmp_path, auth):
+    """Drive the exact clients _enter_real/_cleanup_real build — kubeconfig
+    kube client, production GKE REST client — against the fake backends,
+    through a full provision → assert-pool → discovery-teardown cycle."""
+    async with Environment(tmp_path) as env:   # fakes + operator subprocess
+        genv = {"PROJECT_ID": "test-project", "LOCATION": "us-central2-b",
+                "CLUSTER_NAME": "kaito",
+                "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "e2e-token",
+                "GKE_API_ENDPOINT": f"{env.gcp_url}/v1",
+                "TPU_API_ENDPOINT": f"{env.gcp_url}/v2"}
+        kubeconfig = (str(tmp_path / "kubeconfig") if auth == "token"
+                      else _exec_kubeconfig(tmp_path, env.kube_url))
+        client, nodepools = await connect_real(genv, kubeconfig)
+        try:
+            await client.create(make_nodeclaim("conf0", "tpu-v5e-8"))
+
+            async def ready():
+                nc = await client.get(NodeClaim, "conf0")
+                return (nc if nc.status_conditions.is_true(CONDITION_READY)
+                        else None)
+            await env.eventually(ready, what="conf0 Ready (real-mode client)")
+
+            # node-pool assertion through the PRODUCTION GKE REST client
+            pool = await nodepools.get("conf0")
+            assert pool.name == "conf0"
+            assert pool.config.labels[wk.NODEPOOL_LABEL] \
+                == wk.KAITO_NODEPOOL_NAME
+
+            # the real-mode teardown: discovery-label sweep + unwind wait
+            await discovery_teardown(client, env.eventually, timeout=60)
+
+            async def pool_gone():
+                from gpu_provisioner_tpu.providers.gcp import APIError
+                try:
+                    await nodepools.get("conf0")
+                    return None
+                except APIError as e:
+                    return e.not_found or None
+            await env.eventually(pool_gone, what="conf0 pool deleted")
+        finally:
+            await client.aclose()
+            await nodepools.aclose()
