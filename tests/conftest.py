@@ -9,10 +9,16 @@ The env vars must be set before the first ``import jax`` anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# TPU_POD_TESTS=1 opts out of the CPU forcing so tests/test_tpu_pod.py can
+# drive real multi-chip hardware (staged — no such hardware in this env).
+ON_TPU_POD = os.environ.get("TPU_POD_TESTS") == "1"
+
+if not ON_TPU_POD:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -22,19 +28,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # jax.devices() call even under jax_platforms=cpu, so a wedged TPU tunnel
 # would hang the whole suite — drop the non-CPU factories outright; these
 # tests only ever use the forced-host CPU mesh.
-try:
-    import jax as _jax
-    _jax.config.update("jax_platforms", "cpu")
-    from gpu_provisioner_tpu.parallel.topology import (
-        drop_foreign_backend_factories as _drop)
-    _drop()
-except ImportError:
-    pass
+if not ON_TPU_POD:
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        from gpu_provisioner_tpu.parallel.topology import (
+            drop_foreign_backend_factories as _drop)
+        _drop()
+    except ImportError:
+        pass
 
 import asyncio
 import functools
 
 import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    """TPU_POD_TESTS=1 disables the CPU-platform forcing above, which would
+    strip the wedged-tunnel hang protection from every other test — so in
+    that mode ONLY the pod file runs; everything else is deselected."""
+    if not ON_TPU_POD:
+        return
+    keep = [i for i in items if "test_tpu_pod" in str(i.fspath)]
+    drop = [i for i in items if "test_tpu_pod" not in str(i.fspath)]
+    if drop:
+        config.hook.pytest_deselected(items=drop)
+        items[:] = keep
 
 
 def async_test(fn, timeout: float = 60):
