@@ -23,11 +23,26 @@ ON_TPU_POD = os.environ.get("TPU_POD_TESTS") == "1"
 _reason = "needs TPU_POD_TESTS=1 and >1 real TPU device"
 _ready = False
 if ON_TPU_POD:
-    import jax
+    # Enumerate devices in a KILLABLE subprocess with a bound: a wedged
+    # accelerator tunnel hangs jax.devices() indefinitely (the repo's
+    # documented axon failure mode) and would otherwise hang pytest at
+    # collection rather than skipping.
+    import subprocess
+    import sys as _sys
 
-    devs = jax.devices()
-    _ready = len(devs) > 1 and devs[0].platform.lower() in ("tpu", "axon")
-    _reason = f"needs >1 TPU device, have {len(devs)} {devs[0].platform}"
+    try:
+        out = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(len(d), d[0].platform)"],
+            capture_output=True, timeout=90, text=True).stdout.split()
+        n_dev, platform = int(out[0]), out[1]
+        _ready = n_dev > 1 and platform.lower() in ("tpu", "axon")
+        _reason = f"needs >1 TPU device, have {n_dev} {platform}"
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        _reason = "device enumeration hung/failed (wedged tunnel?)"
+    if _ready:
+        import jax  # noqa: F401 — safe now; the probe proved it returns
 
 pytestmark = pytest.mark.skipif(not _ready, reason=_reason)
 
@@ -111,5 +126,5 @@ def test_flash_kernels_lower_on_chip():
     cached = flash_attention_cached(q[:, :128], kc, vc,
                                     jnp.asarray(17, jnp.int32))
     for x in (out, g, cached):
-        assert bool(jnp.all(jnp.isfinite(
-            jax.tree.leaves(x)[0].astype(jnp.float32))))
+        for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
+            assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
