@@ -31,15 +31,18 @@ if ON_TPU_POD:
     import sys as _sys
 
     try:
-        out = subprocess.run(
+        stdout = subprocess.run(
             [_sys.executable, "-c",
              "import jax; d = jax.devices(); "
-             "print(len(d), d[0].platform)"],
-            capture_output=True, timeout=90, text=True).stdout.split()
-        n_dev, platform = int(out[0]), out[1]
+             "print('PODPROBE', len(d), d[0].platform)"],
+            capture_output=True, timeout=90, text=True).stdout
+        # site hooks may print banners during `import jax` — find our marker
+        probe = next(ln for ln in stdout.splitlines()
+                     if ln.startswith("PODPROBE ")).split()
+        n_dev, platform = int(probe[1]), probe[2]
         _ready = n_dev > 1 and platform.lower() in ("tpu", "axon")
         _reason = f"needs >1 TPU device, have {n_dev} {platform}"
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
+    except (subprocess.TimeoutExpired, StopIteration, ValueError, IndexError):
         _reason = "device enumeration hung/failed (wedged tunnel?)"
     if _ready:
         import jax  # noqa: F401 — safe now; the probe proved it returns
