@@ -46,10 +46,11 @@ async def test_examples_provision_in_envtest():
                 assert nc.status.provider_id, fname
 
 
-@pytest.mark.e2e
-def test_train_resume_example_runs():
-    """The documented workload example (train → checkpoint → resume on a
-    different mesh layout) runs end to end on the CPU mesh."""
+def _run_workload_example(script: str) -> "subprocess.CompletedProcess":
+    """Run an examples/workloads script on the 8-way CPU mesh as its
+    docstring documents. PALLAS_AXON_POOL_IPS="" keeps the axon site hook
+    out of the subprocess: with the TPU tunnel absent/wedged its PJRT
+    probe can hang jax init for the full timeout."""
     import subprocess
     import sys
 
@@ -57,13 +58,27 @@ def test_train_resume_example_runs():
     env = {**os.environ,
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
            "JAX_PLATFORMS": "cpu",
-           # keep the axon site hook out of the subprocess: with the TPU
-           # tunnel absent/wedged its PJRT probe can hang jax init
            "PALLAS_AXON_POOL_IPS": "",
            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
-    r = subprocess.run(
-        [sys.executable, os.path.join(repo, "examples", "workloads",
-                                      "train_resume.py")],
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "workloads", script)],
         env=env, capture_output=True, text=True, timeout=600)
+
+
+@pytest.mark.e2e
+def test_serve_example_runs():
+    """The documented serving example (tp mesh, sampled generation,
+    multi-turn cache continuation) runs end to end on the CPU mesh."""
+    r = _run_workload_example("serve.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sampled:" in r.stdout and "done" in r.stdout
+    assert "multi-turn cache length: 34" in r.stdout
+
+
+@pytest.mark.e2e
+def test_train_resume_example_runs():
+    """The documented workload example (train → checkpoint → resume on a
+    different mesh layout) runs end to end on the CPU mesh."""
+    r = _run_workload_example("train_resume.py")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "resuming on mesh" in r.stdout and "done" in r.stdout
