@@ -16,6 +16,9 @@ slices to serve models, so the framework ships the decode loop, TPU-first:
 - **generate is one ``lax.scan``** over decode steps — the whole
   autoregressive loop is a single compiled program, no host round-trips
   per token;
+- **ragged batches serve left-padded** (``pad_id``): pad keys are masked
+  out of attention and RoPE counts from each row's first real token, so a
+  padded row generates exactly what it would alone;
 - tensor parallelism needs nothing new: cache head dims carry the same
   ``model``-axis specs as the weights (``kv_cache_specs``), and GSPMD
   inserts the collectives exactly as in training.
@@ -61,7 +64,8 @@ def kv_cache_specs(cfg: LlamaConfig) -> KVCache:
     return KVCache(k=spec, v=spec, length=P())
 
 
-def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense"):
+def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
+                      pad_lens=None):
     """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
     a key at position p is attendable iff p <= start + query_idx (causal,
     and positions beyond the written prefix are masked by the same bound).
@@ -75,10 +79,15 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense"):
     sweep. S=1 decode steps always use the dense path (a GEMV-shaped op the
     kernel can't tile).
 
-    k_cache/v_cache: [B, Hkv, max_len, Dh] head-major (one layer's slice)."""
+    k_cache/v_cache: [B, Hkv, max_len, Dh] head-major (one layer's slice).
+
+    ``pad_lens`` [B] (left-padded ragged batches — the standard serving
+    layout): row b's cache positions [0, pad_lens[b]) hold pad tokens that
+    no query may attend to. Pad rows stay on the dense path (the flash
+    kernel masks by position only)."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
-    if impl == "flash":
+    if impl == "flash" and pad_lens is None:
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
@@ -91,16 +100,26 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense"):
     key_pos = jnp.arange(max_len)                      # [K]
     q_pos = start + jnp.arange(S)                      # [S]
     mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
-    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    if pad_lens is None:
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    else:
+        live = key_pos[None, None, :] >= pad_lens[:, None, None]  # [B, 1, K]
+        s = jnp.where((mask[None] & live)[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgqk,bhkd->bqhgd", p, v_cache.astype(jnp.float32))
     return o.reshape(B, S, Hq, Dh).astype(q.dtype)
 
 
-def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
+def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
+                   pad_lens=None):
     """Forward over ``tokens`` [B, S] starting at cache.length; returns
     (logits [B, S, V], updated cache). S is the prompt for prefill, 1 for a
     decode step — same program shape either way.
+
+    ``pad_lens`` [B] int32: left-pad counts for ragged batches (row b's
+    first pad_lens[b] cache slots are dead padding — excluded from
+    attention, and RoPE positions count from the first REAL token so each
+    row sees positions 0,1,2,... regardless of padding).
 
     PRECONDITION (caller-owned): ``cache.length + S <= max_len``. The write
     index is traced, so this cannot be checked here; past the bound,
@@ -112,6 +131,10 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
     B, S = tokens.shape
     start = cache.length
     positions = start + jnp.arange(S, dtype=jnp.int32)
+    if pad_lens is not None:
+        # per-row REAL positions: pad rows clip to 0 (their k/v are masked
+        # out of every attention, so their rope angle is irrelevant)
+        positions = jnp.maximum(positions[None, :] - pad_lens[:, None], 0)
     scale = cfg.head_dim ** -0.5
 
     x = params["embed"].astype(ad)[tokens]
@@ -131,7 +154,7 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig):
             v_cache, v.transpose(0, 2, 1, 3), (0, 0, start, 0))
 
         o = _cached_attention(q, k_cache, v_cache, start, scale,
-                              impl=cfg.attn_impl)
+                              impl=cfg.attn_impl, pad_lens=pad_lens)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         h = _mlp_half(h, lp, cfg)
@@ -179,16 +202,22 @@ def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
 
 
 def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
-            fresh: bool = False):
+            fresh: bool = False, pad_lens=None):
     """(last-token logits [B, V], cache) after consuming the prompt.
     ``fresh=True`` (statically-known-empty cache, e.g. from generate) takes
     the S×S fast path; otherwise the general cached forward runs, correct
-    for continuing a partially-filled cache."""
+    for continuing a partially-filled cache. ``pad_lens`` [B] serves a
+    left-padded ragged batch (see cached_forward) — incompatible with the
+    fresh fast path, whose plain causal attention can't exclude pad keys."""
     if fresh:
+        if pad_lens is not None:
+            raise ValueError("pad_lens requires fresh=False — the fresh "
+                             "fast path cannot mask pad keys")
         logits, cache = _prefill_forward(params, prompt,
                                          cache.k.shape[3], cfg)
     else:
-        logits, cache = cached_forward(params, prompt, cache, cfg)
+        logits, cache = cached_forward(params, prompt, cache, cfg,
+                                       pad_lens=pad_lens)
     return logits[:, -1], cache
 
 
@@ -214,7 +243,8 @@ def _filter_top_p(logits, top_p: float):
 
 def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
              max_len: int = None, temperature: float = 0.0,
-             top_k: int = None, top_p: float = None, key=None):
+             top_k: int = None, top_p: float = None, key=None,
+             pad_id: int = None):
     """Autoregressive generation: prefill, then ONE lax.scan of decode
     steps. prompt: [B, S0] int32 → [B, max_new_tokens] int32.
 
@@ -222,7 +252,14 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     — ``key`` is then REQUIRED (a silent default key would make "sampled"
     serving output deterministic across calls; same required-argument
     rationale as restore_train_state's optimizer). Filters compose in the
-    standard serving order: temperature → top_k → top_p → categorical."""
+    standard serving order: temperature → top_k → top_p → categorical.
+
+    Ragged batches: LEFT-pad prompts to a common S0 with ``pad_id`` (the
+    standard serving layout — every row's last prompt token lands at the
+    same position, so one prefill logit slice serves the whole batch).
+    Pad tokens are excluded from attention and RoPE positions count from
+    each row's first real token, so a padded row generates exactly what it
+    would alone. Every row must contain at least one real token."""
     B, S0 = prompt.shape
     if max_len is None:
         max_len = S0 + max_new_tokens
@@ -236,8 +273,17 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
+    pad_lens = None
+    if pad_id is not None:
+        # leading-pad count per row == index of the first real token
+        pad_lens = jnp.argmax((prompt != pad_id).astype(jnp.int32),
+                              axis=1).astype(jnp.int32)
+
     cache = init_kv_cache(cfg, B, max_len)
-    logits, cache = prefill(params, prompt, cache, cfg, fresh=True)
+    # padded prefill runs the general masked forward (fresh fast path
+    # can't exclude pad keys — see prefill)
+    logits, cache = prefill(params, prompt, cache, cfg,
+                            fresh=pad_id is None, pad_lens=pad_lens)
 
     def pick(logits, key):
         if temperature <= 0:
@@ -257,7 +303,8 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
 
     def step(carry, key_t):
         tok, cache = carry
-        new_logits, cache = cached_forward(params, tok[:, None], cache, cfg)
+        new_logits, cache = cached_forward(params, tok[:, None], cache, cfg,
+                                           pad_lens=pad_lens)
         nxt = pick(new_logits[:, 0], key_t)
         return (nxt, cache), nxt
 

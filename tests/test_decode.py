@@ -214,6 +214,31 @@ def test_generate_topk_topp_reproducible_and_in_vocab():
     assert out3.shape == out1.shape
 
 
+def test_left_padded_ragged_batch_matches_unpadded():
+    """The standard serving layout for ragged prompts: left-pad to a common
+    width. Each padded row must generate EXACTLY what it generates alone —
+    pad keys masked out of attention, RoPE counting from the first real
+    token, prefill and every decode step."""
+    params = init_params(jax.random.key(0), CFG)
+    # real tokens in [1, vocab): 0 is the pad id and must not occur
+    p_short = jax.random.randint(jax.random.key(1), (1, 5), 1,
+                                 CFG.vocab_size)
+    p_long = jax.random.randint(jax.random.key(2), (1, 8), 1,
+                                CFG.vocab_size)
+    solo_short = generate(params, p_short, CFG, max_new_tokens=4)
+    solo_long = generate(params, p_long, CFG, max_new_tokens=4)
+
+    padded = jnp.concatenate(
+        [jnp.zeros((1, 3), p_short.dtype), p_short], axis=1)
+    batch = jnp.concatenate([padded, p_long], axis=0)          # [2, 8]
+    out = jax.jit(lambda pr, t: generate(pr, t, CFG, max_new_tokens=4,
+                                         pad_id=0))(params, batch)
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.asarray(solo_short[0]))
+    np.testing.assert_array_equal(np.asarray(out[1]),
+                                  np.asarray(solo_long[0]))
+
+
 def test_generate_sampling_reproducible_and_in_vocab():
     params = init_params(jax.random.key(0), CFG)
     prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)
