@@ -397,16 +397,29 @@ def bench_flash_op(fast: bool) -> dict:
         q2 = jax.random.normal(ks2[0], (1, S2, 8, 128), jnp.bfloat16)
         k2 = jax.random.normal(ks2[1], (1, S2, 4, 128), jnp.bfloat16)
         v2 = jax.random.normal(ks2[2], (1, S2, 4, 128), jnp.bfloat16)
-        f = jax.jit(lambda a, b, c: flash_attention(a, b, c))
-        settle(f(q2, k2, v2))
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            o = f(q2, k2, v2)
-            settle(o)
-            best = min(best, time.perf_counter() - t0)
+
+        def time_jitted(fn):
+            f = jax.jit(fn)
+            settle(f(q2, k2, v2))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                o = f(q2, k2, v2)
+                settle(o)
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3
+
         out["streaming_seq_len"] = S2
-        out["streaming_ms"] = best * 1e3
+        out["streaming_ms"] = time_jitted(
+            lambda a, b, c: flash_attention(a, b, c))
+        try:
+            # triangular grid (opt-in, first on-chip validation happens
+            # right here): own guard so a lowering failure records an
+            # error instead of killing the section
+            out["streaming_tri_ms"] = time_jitted(
+                lambda a, b, c: flash_attention(a, b, c, triangular=True))
+        except Exception as e:
+            out["streaming_tri_error"] = f"{type(e).__name__}: {e}"
     return out
 
 

@@ -216,6 +216,43 @@ def _causal_kv_index(block_q, block_k, group, causal, *,
     return idx
 
 
+def _tri_decode(t, n_q):
+    """Flattened triangular index → (qi, kj) for the causal lower triangle
+    (block_q == block_k): cell t of row qi starts at qi(qi+1)/2. Inverse
+    via float sqrt with a ±1 integer correction (exact for any grid that
+    fits int32 — sqrt is only a seed, the corrections decide)."""
+    del n_q  # shape bookkeeping only; decode is closed-form
+    tf = t.astype(jnp.float32)
+    qi = jnp.floor((jnp.sqrt(8.0 * tf + 1.0) - 1.0) / 2.0).astype(jnp.int32)
+    qi = jnp.where(qi * (qi + 1) // 2 > t, qi - 1, qi)
+    qi = jnp.where((qi + 1) * (qi + 2) // 2 <= t, qi + 1, qi)
+    kj = t - qi * (qi + 1) // 2
+    return qi, kj
+
+
+def _kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, block, n_q, scale):
+    """Causal streaming forward over the FLATTENED lower triangle: the grid
+    holds only live (qi, kj) cells, so above-diagonal cells cost nothing at
+    all — not even the predicated-off grid steps the rectangular variant
+    pays (~half the grid at long S)."""
+    t = pl.program_id(1)
+    qi, kj = _tri_decode(t, n_q)
+
+    @pl.when(kj == 0)
+    def _init():
+        _init_softmax_scratch(acc_ref, m_ref, l_ref)
+
+    _online_softmax_step(
+        q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+        q_pos0=qi * block, kv_pos0=kj * block,
+        block_q=block, block_k=block, scale=scale, masked=True)
+
+    @pl.when(kj == qi)
+    def _finalize():
+        _finalize_out(o_ref, acc_ref, m_ref, l_ref, lse_ref)
+
+
 def _causal_q_index(block_q, block_k, causal):
     """q-side index map for (bh, kj, qi) grids (the dK/dV pass). The dead
     prefix of the qi loop (blocks strictly before the diagonal) is clamped
@@ -230,7 +267,8 @@ def _causal_q_index(block_q, block_k, causal):
     return idx
 
 
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           triangular=False):
     """Flash forward on flattened heads → (out [B,S,Hq,D], lse [B*Hq, S, 1])."""
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -268,6 +306,41 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
                              memory_space=pltpu.VMEM),
             ],
             out_shape=out_shapes,
+            interpret=interpret,
+        )(qf, kf, vf)
+        return _rows_to_heads(out, B, Hq), lse
+
+    if causal and triangular and block_q == block_k:
+        # flattened-triangle grid: above-diagonal cells don't exist at all
+        # (the rectangular variant below predicates them off and elides
+        # their DMA, but still pays the grid step)
+        n_q = S // block_q
+        tri_q = lambda bh, t: (bh, _tri_decode(t, n_q)[0], 0)
+        tri_kv = lambda bh, t, g=group: (bh // g, _tri_decode(t, n_q)[1], 0)
+        out, lse = pl.pallas_call(
+            functools.partial(_kernel_tri, block=block_q, n_q=n_q,
+                              scale=scale),
+            grid=(B * Hq, n_q * (n_q + 1) // 2),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), tri_q,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, D), tri_kv,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, D), tri_kv,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), tri_q,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q, 1), tri_q,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=out_shapes,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),   # acc
+                pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+                pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+            ],
             interpret=interpret,
         )(qf, kf, vf)
         return _rows_to_heads(out, B, Hq), lse
@@ -601,20 +674,27 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
             _rows_to_heads(dv.astype(v.dtype), B, Hkv))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret,
+                    triangular):
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+                      triangular)
     B, _, Hq, _ = q.shape
     return out, lse.reshape(B, Hq, -1)
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   triangular):
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+                      triangular)
     B, _, Hq, _ = q.shape
     return (out, lse.reshape(B, Hq, -1)), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, triangular,
+                   res, g):
+    # the backward kernels are rectangular either way — `triangular` only
+    # shapes the forward grid; lse/out arrive identical from both variants
     q, k, v, o, lse = res
     g_out, g_lse = g
     B, S, Hq, _ = q.shape
@@ -628,10 +708,21 @@ _flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              scale: float = None, block_q: int = None,
-                             block_k: int = None, interpret: bool = None):
+                             block_k: int = None, interpret: bool = None,
+                             triangular: bool = False):
     """flash_attention that also returns the per-row logsumexp [B, Hq, S] —
     the combination handle ring attention needs to merge partial attentions
-    across ring steps (parallel/ring.py). Differentiable in both outputs."""
+    across ring steps (parallel/ring.py). Differentiable in both outputs.
+
+    ``triangular=True``: the causal streaming forward runs on a flattened
+    lower-triangle grid — above-diagonal cells vanish instead of being
+    predicated off (~half the grid steps at long S). Engages ONLY when the
+    streaming variant runs (K/V past RESIDENT_KV_BUDGET) with
+    block_q == block_k and causal=True; anywhere else the flag is a no-op
+    (the resident/rectangular kernels run as usual — don't benchmark it in
+    the resident regime). Opt-in until validated on real TPU (staged in
+    tests/test_tpu_pod.py; bench.py times it in its own guarded section) —
+    flip the default once a chip has signed it off."""
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
     if scale is None:
@@ -645,12 +736,12 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     return _flash_lse_diff(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+                           interpret, triangular)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
                     block_q: int = None, block_k: int = None,
-                    interpret: bool = None):
+                    interpret: bool = None, triangular: bool = False):
     """Drop-in for dense_attention: q [B,S,Hq,D], k/v [B,S,Hkv,D] → [B,S,Hq,D].
 
     Takes the Pallas kernel only when S tiles exactly into the given
@@ -661,4 +752,5 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
     """
     return flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
-                                    interpret=interpret)[0]
+                                    interpret=interpret,
+                                    triangular=triangular)[0]

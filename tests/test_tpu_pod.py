@@ -128,6 +128,11 @@ def test_flash_kernels_lower_on_chip():
     vc = jax.random.normal(ks[2], (1, 2, 2048, 128), jnp.bfloat16)
     cached = flash_attention_cached(q[:, :128], kc, vc,
                                     jnp.asarray(17, jnp.int32))
-    for x in (out, g, cached):
+    # streaming variants: the default rectangular grid AND the opt-in
+    # triangular grid (S=16384 exceeds the residency budget → streaming)
+    qs, ks_, vs = (jnp.tile(x, (1, 16, 1, 1)) for x in (q, k, v))
+    stream = flash_attention(qs, ks_, vs)
+    tri = flash_attention(qs, ks_, vs, triangular=True)
+    for x in (out, g, cached, stream, tri):
         for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
             assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
