@@ -309,12 +309,17 @@ def bench_decode(fast: bool) -> dict:
     from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
 
     dev = jax.devices()[0]
+    # attn_impl="flash": the deployment configuration — prefill takes the
+    # Pallas kernel (S0 tiles), S=1 decode steps auto-fall-back to dense
     cfg = (LlamaConfig(vocab_size=2048, dim=512, n_layers=4, n_heads=8,
-                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16")
+                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16",
+                       attn_impl="flash")
            if fast else
            LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
-                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16"))
-    B, S0, NEW = (2, 64, 16) if fast else (8, 512, 128)
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16",
+                       attn_impl="flash"))
+    # fast S0=128 so the flash prefill actually engages (blocks need >=128)
+    B, S0, NEW = (2, 128, 16) if fast else (8, 512, 128)
     params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
     prompt = jax.device_put(
         jnp.zeros((B, S0), jnp.int32), dev)

@@ -136,3 +136,9 @@ def test_flash_kernels_lower_on_chip():
     for x in (out, g, cached, stream, tri):
         for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
             assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
+    # value-level sign-off for the triangular grid (the docstring's gate
+    # for flipping the default): a finite-but-wrong sqrt index decode on
+    # the scalar core would slip past the isfinite loop
+    np.testing.assert_allclose(
+        np.asarray(tri.astype(jnp.float32)),
+        np.asarray(stream.astype(jnp.float32)), atol=2e-2, rtol=2e-2)
