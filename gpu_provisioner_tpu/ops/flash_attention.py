@@ -501,10 +501,41 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
 
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------
 
+def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc, *,
+                 qi, kj, block_q, block_k, scale, causal):
+    """One dQ tile: dQ_i += scale · [P_ij ∘ (dO_i V_jᵀ − Δ_i)] K_j with P
+    rebuilt from the saved logsumexp. Shared by the rectangular and
+    triangular dq grids."""
+    q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)                    # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)                  # [BQ, D]
+    lse = lse_ref[0]                                    # [BQ, 1]
+    delta = delta_ref[0]                                # [BQ, 1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    p = jnp.where(lse > NEG_INF / 2, p, 0.0)            # fully-masked rows
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BQ, BK]
+    ds = p * (dp - delta) * scale
+    dq_acc[:] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, block_q, block_k, scale, causal):
-    """dQ_i = scale · Σ_j [P_ij ∘ (dO_i V_jᵀ − Δ_i)] K_j, accumulated over
-    kv-blocks in VMEM scratch. P is rebuilt from the saved logsumexp."""
+    """dQ accumulated over kv-blocks in VMEM scratch (rectangular grid)."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -517,42 +548,72 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                    # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)                  # [BQ, D]
-        lse = lse_ref[0]                                    # [BQ, 1]
-        delta = delta_ref[0]                                # [BQ, 1]
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        p = jnp.where(lse > NEG_INF / 2, p, 0.0)            # fully-masked rows
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BQ, BK]
-        ds = p * (dp - delta) * scale
-        dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_acc, qi=qi, kj=kj, block_q=block_q, block_k=block_k,
+                     scale=scale, causal=causal)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, dq_acc, *, block, n_q, scale):
+    """dQ over the flattened causal lower triangle (see _kernel_tri)."""
+    t = pl.program_id(1)
+    qi, kj = _tri_decode(t, n_q)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc,
+                 qi=qi, kj=kj, block_q=block, block_k=block, scale=scale,
+                 causal=True)
+
+    @pl.when(kj == qi)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_acc,
+                  dv_acc, *, qi, kj, block_q, block_k, scale, causal):
+    """One dK/dV tile: dV_j += P_ijᵀ dO_i ; dK_j += scale·[P∘(dP−Δ)]ᵀ Q_i.
+    Shared by the rectangular and reversed-triangle dkv grids."""
+    q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)                    # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    p = jnp.where(lse > NEG_INF / 2, p, 0.0)
+    dv_acc[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BK, D]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BQ, BK]
+    ds = p * (dp - delta) * scale
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BK, D]
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
                     scale, causal):
-    """dV_j = Σ_i P_ijᵀ dO_i ; dK_j = scale · Σ_i [P ∘ (dP − Δ)]ᵀ Q_i,
-    accumulated over q-blocks. Grid is (bh, kv-block, q-block)."""
+    """dK/dV accumulated over q-blocks. Grid is (bh, kv-block, q-block)."""
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -566,34 +627,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                    # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        p = jnp.where(lse > NEG_INF / 2, p, 0.0)
-        dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BK, D]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BQ, BK]
-        ds = p * (dp - delta) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BK, D]
+        _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_acc, dv_acc, qi=qi, kj=kj, block_q=block_q,
+                      block_k=block_k, scale=scale, causal=causal)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -601,8 +637,39 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _tri_decode_rev(t, n_q):
+    """Flattened index → (kj, qi) for the causal dkv triangle (qi ≥ kj):
+    substituting u = n-1-kj, v = n-1-qi maps it onto the standard lower
+    triangle, so the same decode serves. Row u iterates qi DESCENDING from
+    n-1 to kj — first visit v=0 (init), last v=u i.e. qi == kj (finalize)."""
+    u, v = _tri_decode(t, n_q)
+    return n_q - 1 - u, n_q - 1 - v
+
+
+def _bwd_dkv_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_acc, dv_acc, *, block, n_q,
+                        scale):
+    """dK/dV over the flattened causal triangle (reversed coordinates)."""
+    t = pl.program_id(1)
+    kj, qi = _tri_decode_rev(t, n_q)
+
+    @pl.when(qi == n_q - 1)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dk_acc, dv_acc, qi=qi, kj=kj, block_q=block,
+                  block_k=block, scale=scale, causal=True)
+
+    @pl.when(qi == kj)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                    interpret, g_lse=None):
+                    interpret, g_lse=None, triangular=False):
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
@@ -618,6 +685,10 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         # lse cotangent folds straight into Δ: dS = P∘(dP − Δ + ḡ_lse)
         # because ∂lse/∂S = P — the kernels run unchanged on Δ' = Δ − ḡ.
         delta = delta - g_lse.astype(jnp.float32)
+
+    if causal and triangular and block_q == block_k:
+        return _flash_bwd_tri(qf, kf, vf, dof, lse, delta, B, S, Hq, Hkv,
+                              D, group, scale, block_q, interpret, q, k, v)
 
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
@@ -674,6 +745,63 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
             _rows_to_heads(dv.astype(v.dtype), B, Hkv))
 
 
+def _flash_bwd_tri(qf, kf, vf, dof, lse, delta, B, S, Hq, Hkv, D, group,
+                   scale, block, interpret, q, k, v):
+    """Backward over flattened causal triangles: dq on the lower triangle,
+    dk/dv on the reversed one — dead cells don't exist in either grid."""
+    n_q = S // block
+    T = n_q * (n_q + 1) // 2
+
+    q_idx = lambda bh, t: (bh, _tri_decode(t, n_q)[0], 0)
+    kv_idx = lambda bh, t, g_=group: (bh // g_, _tri_decode(t, n_q)[1], 0)
+    qspec = pl.BlockSpec((1, block, D), q_idx, memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, block, D), kv_idx, memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, block, 1), q_idx, memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_tri, block=block, n_q=n_q,
+                          scale=scale),
+        grid=(B * Hq, T),
+        in_specs=[qspec, kvspec, kvspec, qspec, rowq, rowq],
+        out_specs=pl.BlockSpec((1, block, D), q_idx,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    kv_idx2 = lambda bh, t, g_=group: \
+        (bh // g_, _tri_decode_rev(t, n_q)[0], 0)
+    q_idx2 = lambda bh, t: (bh, _tri_decode_rev(t, n_q)[1], 0)
+    qspec2 = pl.BlockSpec((1, block, D), q_idx2, memory_space=pltpu.VMEM)
+    kvspec2 = pl.BlockSpec((1, block, D), kv_idx2, memory_space=pltpu.VMEM)
+    rowq2 = pl.BlockSpec((1, block, 1), q_idx2, memory_space=pltpu.VMEM)
+    # dk/dv are PER-Q-HEAD (bh, not bh//group — GQA folds after the
+    # kernel, exactly like the rectangular path)
+    dkv_out = pl.BlockSpec(
+        (1, block, D), lambda bh, t: (bh, _tri_decode_rev(t, n_q)[0], 0),
+        memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_tri, block=block, n_q=n_q,
+                          scale=scale),
+        grid=(B * Hq, T),
+        in_specs=[qspec2, kvspec2, kvspec2, qspec2, rowq2, rowq2],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[jax.ShapeDtypeStruct((B * Hq, S, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B * Hq, S, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block, D), jnp.float32),
+                        pltpu.VMEM((block, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(B, Hkv, group, S, D).sum(axis=2).reshape(B * Hkv, S, D)
+        dv = dv.reshape(B, Hkv, group, S, D).sum(axis=2).reshape(B * Hkv, S, D)
+
+    return (_rows_to_heads(dq, B, Hq),
+            _rows_to_heads(dk.astype(k.dtype), B, Hkv),
+            _rows_to_heads(dv.astype(v.dtype), B, Hkv))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret,
                     triangular):
@@ -693,14 +821,13 @@ def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
 def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, triangular,
                    res, g):
-    # the backward kernels are rectangular either way — `triangular` only
-    # shapes the forward grid; lse/out arrive identical from both variants
     q, k, v, o, lse = res
     g_out, g_lse = g
     B, S, Hq, _ = q.shape
     return _flash_bwd_impl(q, k, v, o, lse, g_out, causal, scale, block_q,
                            block_k, interpret,
-                           g_lse=g_lse.reshape(B * Hq, S, 1))
+                           g_lse=g_lse.reshape(B * Hq, S, 1),
+                           triangular=triangular)
 
 
 _flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -714,10 +841,11 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     the combination handle ring attention needs to merge partial attentions
     across ring steps (parallel/ring.py). Differentiable in both outputs.
 
-    ``triangular=True``: the causal streaming forward runs on a flattened
-    lower-triangle grid — above-diagonal cells vanish instead of being
-    predicated off (~half the grid steps at long S). Engages ONLY when the
-    streaming variant runs (K/V past RESIDENT_KV_BUDGET) with
+    ``triangular=True``: causal grids flatten to their live triangles —
+    above/below-diagonal dead cells vanish instead of being predicated off
+    (~half the grid steps at long S). Applies to the STREAMING forward
+    (K/V past RESIDENT_KV_BUDGET) and to BOTH backward passes (dq on the
+    lower triangle, dk/dv on the reversed one), always requiring
     block_q == block_k and causal=True; anywhere else the flag is a no-op
     (the resident/rectangular kernels run as usual — don't benchmark it in
     the resident regime). Opt-in until validated on real TPU (staged in

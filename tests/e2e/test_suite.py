@@ -224,7 +224,9 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
                                 labels={wk.TPU_SLICE_GROUP_LABEL: "dpgroup"})
             await env.client.create(nc)
         for i in range(4):
-            await env.expect_nodeclaim_ready(f"slice{i}", timeout=60)
+            # suite default (90s fake / E2E_TIMEOUT_SECONDS): the 4-slice
+            # wave flaked once at 60s under heavy CPU contention
+            await env.expect_nodeclaim_ready(f"slice{i}")
         nodes = await env.expect_node_count(8)  # 4 slices × 2 hosts
         groups = {n.metadata.labels.get(wk.TPU_SLICE_GROUP_LABEL)
                   for n in nodes}

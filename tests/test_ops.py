@@ -72,9 +72,10 @@ def test_flash_streaming_variant_matches_dense(monkeypatch):
 
 
 def test_flash_triangular_streaming_matches_dense(monkeypatch):
-    """Flattened-triangle causal streaming grid (opt-in) vs dense, forward
-    AND gradients (the backward is rectangular but consumes the triangular
-    forward's saved lse)."""
+    """Flattened-triangle causal grids (opt-in) vs dense, forward AND
+    gradients — the grad path runs the triangular dq kernel (lower
+    triangle) and dkv kernel (reversed triangle, _tri_decode_rev), so this
+    is the primary numeric gate for all three tri kernels."""
     import importlib
     fa_mod = importlib.import_module("gpu_provisioner_tpu.ops.flash_attention")
     monkeypatch.setattr(fa_mod, "RESIDENT_KV_BUDGET", 0)

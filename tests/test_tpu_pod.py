@@ -124,6 +124,9 @@ def test_flash_kernels_lower_on_chip():
     out = flash_attention(q, k, v)                       # resident fwd
     g = jax.grad(lambda *a: jnp.sum(flash_attention(*a)
                                     .astype(jnp.float32) ** 2))(q, k, v)
+    g_tri = jax.grad(lambda *a: jnp.sum(                 # triangular bwd
+        flash_attention(*a, triangular=True).astype(jnp.float32) ** 2))(
+        q, k, v)
     kc = jax.random.normal(ks[1], (1, 2, 2048, 128), jnp.bfloat16)
     vc = jax.random.normal(ks[2], (1, 2, 2048, 128), jnp.bfloat16)
     cached = flash_attention_cached(q[:, :128], kc, vc,
@@ -133,12 +136,17 @@ def test_flash_kernels_lower_on_chip():
     qs, ks_, vs = (jnp.tile(x, (1, 16, 1, 1)) for x in (q, k, v))
     stream = flash_attention(qs, ks_, vs)
     tri = flash_attention(qs, ks_, vs, triangular=True)
-    for x in (out, g, cached, stream, tri):
+    for x in (out, g, g_tri, cached, stream, tri):
         for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
             assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
-    # value-level sign-off for the triangular grid (the docstring's gate
+    # value-level sign-off for the triangular grids (the docstring's gate
     # for flipping the default): a finite-but-wrong sqrt index decode on
-    # the scalar core would slip past the isfinite loop
+    # the scalar core would slip past the isfinite loop — forward AND
+    # backward (dkv uses _tri_decode_rev, which only the bwd exercises)
     np.testing.assert_allclose(
         np.asarray(tri.astype(jnp.float32)),
         np.asarray(stream.astype(jnp.float32)), atol=2e-2, rtol=2e-2)
+    for a, b in zip(g_tri, g):
+        np.testing.assert_allclose(np.asarray(a.astype(jnp.float32)),
+                                   np.asarray(b.astype(jnp.float32)),
+                                   atol=2e-2, rtol=2e-2)
