@@ -244,7 +244,7 @@ def _filter_top_p(logits, top_p: float):
 def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
              max_len: int = None, temperature: float = 0.0,
              top_k: int = None, top_p: float = None, key=None,
-             pad_id: int = None):
+             pad_id: int = None, eos_id: int = None):
     """Autoregressive generation: prefill, then ONE lax.scan of decode
     steps. prompt: [B, S0] int32 → [B, max_new_tokens] int32.
 
@@ -259,7 +259,13 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     same position, so one prefill logit slice serves the whole batch).
     Pad tokens are excluded from attention and RoPE positions count from
     each row's first real token, so a padded row generates exactly what it
-    would alone. Every row must contain at least one real token."""
+    would alone. Every row must contain at least one real token.
+
+    ``eos_id``: rows that emit it are FINISHED — every later position in
+    that row comes back as eos_id (the scan runs to max_new_tokens; XLA
+    has no early exit, finished rows just stop contributing real tokens —
+    the HF unfinished_sequences convention, so downstream truncation is a
+    simple == eos_id scan)."""
     B, S0 = prompt.shape
     if max_len is None:
         max_len = S0 + max_new_tokens
@@ -300,13 +306,17 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     # first token comes straight from the prefill logits; the scan then does
     # forward-then-pick, so no decode forward is ever computed and discarded
     tok0 = pick(logits, keys[0])
+    done0 = (tok0 == eos_id) if eos_id is not None else None
 
     def step(carry, key_t):
-        tok, cache = carry
+        tok, done, cache = carry
         new_logits, cache = cached_forward(params, tok[:, None], cache, cfg,
                                            pad_lens=pad_lens)
         nxt = pick(new_logits[:, 0], key_t)
-        return (nxt, cache), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, done, cache), nxt
 
-    (_, _), rest = lax.scan(step, (tok0, cache), keys[1:])
+    (_, _, _), rest = lax.scan(step, (tok0, done0, cache), keys[1:])
     return jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)

@@ -501,11 +501,12 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
 
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------
 
-def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc, *,
-                 qi, kj, block_q, block_k, scale, causal):
-    """One dQ tile: dQ_i += scale · [P_ij ∘ (dO_i V_jᵀ − Δ_i)] K_j with P
-    rebuilt from the saved logsumexp. Shared by the rectangular and
-    triangular dq grids."""
+def _rebuild_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+                  qi, kj, block_q, block_k, scale, causal):
+    """Recompute one tile's P = exp(S − lse) (fully-masked-row guarded) and
+    dS = P ∘ (dP − Δ)·scale — the shared core of both backward passes
+    (FlashAttention-2 §3.2); only the final accumulation matmuls differ.
+    Returns (q, k, do, p, ds)."""
     q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
     k = k_ref[0].astype(jnp.float32)                    # [BK, D]
     v = v_ref[0].astype(jnp.float32)
@@ -528,6 +529,16 @@ def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc, *,
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)             # [BQ, BK]
     ds = p * (dp - delta) * scale
+    return q, k, do, p, ds
+
+
+def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc, *,
+                 qi, kj, block_q, block_k, scale, causal):
+    """One dQ tile: dQ_i += dS_ij K_j. Shared by the rectangular and
+    triangular dq grids."""
+    _, k, _, _, ds = _rebuild_p_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi=qi, kj=kj,
+        block_q=block_q, block_k=block_k, scale=scale, causal=causal)
     dq_acc[:] += jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -578,33 +589,14 @@ def _bwd_dq_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_acc,
                   dv_acc, *, qi, kj, block_q, block_k, scale, causal):
-    """One dK/dV tile: dV_j += P_ijᵀ dO_i ; dK_j += scale·[P∘(dP−Δ)]ᵀ Q_i.
-    Shared by the rectangular and reversed-triangle dkv grids."""
-    q = q_ref[0].astype(jnp.float32)                    # [BQ, D]
-    k = k_ref[0].astype(jnp.float32)                    # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, 1), 0)
-        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
-        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-    p = jnp.exp(s - lse)
-    p = jnp.where(lse > NEG_INF / 2, p, 0.0)
+    """One dK/dV tile: dV_j += P_ijᵀ dO_i ; dK_j += dS_ijᵀ Q_i. Shared by
+    the rectangular and reversed-triangle dkv grids."""
+    q, _, do, p, ds = _rebuild_p_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi=qi, kj=kj,
+        block_q=block_q, block_k=block_k, scale=scale, causal=causal)
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)             # [BK, D]
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)             # [BQ, BK]
-    ds = p * (dp - delta) * scale
     dk_acc[:] += jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)             # [BK, D]

@@ -214,6 +214,23 @@ def test_generate_topk_topp_reproducible_and_in_vocab():
     assert out3.shape == out1.shape
 
 
+def test_generate_eos_finishes_rows_independently():
+    """Once a row emits eos_id every later position is eos_id (the HF
+    unfinished_sequences convention); other rows keep generating."""
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)
+    base = np.asarray(generate(params, prompt, CFG, max_new_tokens=5))
+    # choose row 0's second token as the eos — row 0 then finishes at
+    # position 1; precondition: it must not occur in row 1's output
+    eos = int(base[0, 1])
+    assert eos not in base[1], "pick a different seed"
+    out = np.asarray(generate(params, prompt, CFG, max_new_tokens=5,
+                              eos_id=eos))
+    np.testing.assert_array_equal(out[0, :2], base[0, :2])  # up to + incl eos
+    assert (out[0, 1:] == eos).all()                        # finished
+    np.testing.assert_array_equal(out[1], base[1])          # unaffected
+
+
 def test_left_padded_ragged_batch_matches_unpadded():
     """The standard serving layout for ragged prompts: left-pad to a common
     width. Each padded row must generate EXACTLY what it generates alone —
