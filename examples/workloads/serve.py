@@ -51,7 +51,9 @@ def main():
     params = shard_params(init_params(jax.random.key(0), cfg), mesh, cfg)
     print(f"serving on mesh {dict(mesh.shape)}")
 
-    prompt = jax.random.randint(jax.random.key(1), (2, 16), 0,
+    # real tokens from [1, vocab): 0 is the ragged demo's pad id and must
+    # not occur in prompts (a leading real 0 would be miscounted as pad)
+    prompt = jax.random.randint(jax.random.key(1), (2, 16), 1,
                                 cfg.vocab_size)
 
     # one-shot: greedy and sampled generation (single compiled scan each)
@@ -61,6 +63,16 @@ def main():
                        key=jax.random.key(7))
     print("greedy :", greedy[0].tolist())
     print("sampled:", sampled[0].tolist())
+
+    # ragged batch: left-pad mixed-length prompts (pad_id), finish rows at
+    # eos (eos_id) — each padded row generates exactly what it would alone
+    short = prompt[:1, :6]
+    ragged = jnp.concatenate(
+        [jnp.concatenate([jnp.zeros((1, 10), short.dtype), short], 1),
+         prompt[1:, :16]], 0)
+    out = generate(params, ragged, cfg, max_new_tokens=8, pad_id=0,
+                   eos_id=int(greedy[0, -1]))
+    print("ragged :", out.tolist())
 
     # multi-turn: turn-1 prefill → decode 2 → turn-2 prefill continues the
     # SAME cache (flash-kernel path for block-sized turns under
