@@ -9,13 +9,14 @@ topology labels (parallel/topology.py).
 
 from .checkpoint import (TrainCheckpointManager, restore_train_state,
                          save_train_state)
-from .decode import KVCache, generate, init_kv_cache, prefill
+from .decode import (KVCache, generate, init_kv_cache, prefill,
+                     prefill_chunked)
 from .llama import LlamaConfig, forward, init_params, param_specs
 from .train import make_train_state, make_train_step
 
 __all__ = [
     "LlamaConfig", "init_params", "forward", "param_specs",
     "make_train_state", "make_train_step",
-    "KVCache", "init_kv_cache", "prefill", "generate",
+    "KVCache", "init_kv_cache", "prefill", "prefill_chunked", "generate",
     "save_train_state", "restore_train_state", "TrainCheckpointManager",
 ]

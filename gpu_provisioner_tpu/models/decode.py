@@ -221,6 +221,33 @@ def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
     return logits[:, -1], cache
 
 
+_cached_forward_jit = jax.jit(cached_forward, static_argnums=(3,))
+
+
+def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
+                    *, chunk: int = 2048, pad_lens=None):
+    """(last-token logits [B, V], cache) — prefill in ``chunk``-sized
+    pieces so peak activation memory is O(chunk·S) instead of O(S²)-ish
+    for very long prompts, while each piece still takes the cache-aware
+    flash kernel (blocks tile per chunk). Numerically identical to one
+    cached_forward over the whole prompt: chunk c attends to everything
+    written before it plus its own causal prefix — exactly the full causal
+    mask, evaluated piecewise. Each piece runs through a jitted
+    cached_forward, so at most two programs compile (full chunk +
+    remainder). Call it EAGERLY — under an outer jit the loop unrolls into
+    one trace that grows with S/chunk."""
+    B, S = prompt.shape
+    if S == 0 or chunk <= 0:
+        raise ValueError(f"need a non-empty prompt (S={S}) and a positive "
+                         f"chunk ({chunk})")
+    logits = None
+    for off in range(0, S, chunk):
+        piece = prompt[:, off:off + chunk]     # slice stop clamps at S
+        logits, cache = _cached_forward_jit(params, piece, cache, cfg,
+                                            pad_lens=pad_lens)
+    return logits[:, -1], cache
+
+
 def _filter_top_k(logits, top_k: int):
     """Keep the k highest logits per row; the rest → -inf."""
     vals = jax.lax.top_k(logits, top_k)[0]

@@ -214,6 +214,30 @@ def test_generate_topk_topp_reproducible_and_in_vocab():
     assert out3.shape == out1.shape
 
 
+def test_chunked_prefill_matches_single_shot():
+    """prefill_chunked == one cached_forward over the whole prompt, on
+    logits, cache contents and length — incl. a ragged final chunk."""
+    from gpu_provisioner_tpu.models.decode import prefill_chunked
+
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 22), 0,
+                                CFG.vocab_size)
+    full, full_cache = cached_forward(params, prompt,
+                                      init_kv_cache(CFG, 2, 32), CFG)
+    last, ck_cache = prefill_chunked(params, prompt,
+                                     init_kv_cache(CFG, 2, 32), CFG,
+                                     chunk=8)   # 8+8+6: ragged tail
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1]),
+                               atol=3e-2, rtol=3e-2)
+    assert int(ck_cache.length) == int(full_cache.length) == 22
+    np.testing.assert_allclose(
+        np.asarray(ck_cache.k.astype(jnp.float32)),
+        np.asarray(full_cache.k.astype(jnp.float32)), atol=3e-2, rtol=3e-2)
+    np.testing.assert_allclose(
+        np.asarray(ck_cache.v.astype(jnp.float32)),
+        np.asarray(full_cache.v.astype(jnp.float32)), atol=3e-2, rtol=3e-2)
+
+
 def test_generate_eos_finishes_rows_independently():
     """Once a row emits eos_id every later position is eos_id (the HF
     unfinished_sequences convention); other rows keep generating."""
