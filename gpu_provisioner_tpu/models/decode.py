@@ -30,7 +30,7 @@ cache — the K/V expansion never materializes.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,10 +48,35 @@ class KVCache(NamedTuple):
     k: jax.Array        # [L, B, Hkv, max_len, Dh] (head-major — see module doc)
     v: jax.Array        # [L, B, Hkv, max_len, Dh]
     length: jax.Array   # scalar int32 — tokens written so far
+    # int8 mode only (cfg.kv_cache_dtype="int8"): per-token-per-head
+    # symmetric scales, [L, B, Hkv, max_len, 1] f32 — None in fp mode
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+
+def _kv_int8(cfg: LlamaConfig) -> bool:
+    """Validated kv_cache_dtype dispatch — unknown values raise instead of
+    silently serving a full-precision cache (same loud-validation rule as
+    resolve_attn: a typo must not quietly halve the promised headroom)."""
+    if cfg.kv_cache_dtype not in ("auto", "int8"):
+        raise ValueError(f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r}; "
+                         "expected 'auto'|'int8'")
+    return cfg.kv_cache_dtype == "int8"
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    """Zeroed cache per cfg.kv_cache_dtype: "auto" stores act_dtype;
+    "int8" stores int8 values + f32 per-token-per-head scales — HALF the
+    serving cache HBM at bf16 activations (the scales add 1/Dh), so double
+    the batch or context per chip. Scores dequantize on the fly."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    if _kv_int8(cfg):
+        sshape = shape[:-1] + (1,)
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       length=jnp.zeros((), jnp.int32),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
     return KVCache(k=jnp.zeros(shape, cfg.act_dtype),
                    v=jnp.zeros(shape, cfg.act_dtype),
                    length=jnp.zeros((), jnp.int32))
@@ -61,11 +86,26 @@ def kv_cache_specs(cfg: LlamaConfig) -> KVCache:
     """PartitionSpecs mirroring the attention weights' tp layout (kv heads
     over ``model``) so the cache shards with the model."""
     spec = P(None, None, AXIS_MODEL, None, None)
+    if _kv_int8(cfg):
+        return KVCache(k=spec, v=spec, length=P(),
+                       k_scale=spec, v_scale=spec)
     return KVCache(k=spec, v=spec, length=P())
 
 
+def _quantize_kv(x):
+    """Per-token-per-head symmetric int8: [B, S, Hkv, Dh] →
+    (int8 values, f32 scales [B, S, Hkv, 1]). Head-dim max keeps the
+    quantization step proportional to each token's own key/value magnitude
+    (RoPE'd keys are norm-preserving, so the range is stable)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scl = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scl), -127, 127).astype(jnp.int8)
+    return q, scl
+
+
 def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
-                      pad_lens=None):
+                      pad_lens=None, k_scale=None, v_scale=None):
     """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
     a key at position p is attendable iff p <= start + query_idx (causal,
     and positions beyond the written prefix are masked by the same bound).
@@ -84,19 +124,29 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     ``pad_lens`` [B] (left-padded ragged batches — the standard serving
     layout): row b's cache positions [0, pad_lens[b]) hold pad tokens that
     no query may attend to. Pad rows stay on the dense path (the flash
-    kernel masks by position only)."""
+    kernel masks by position only).
+
+    ``k_scale``/``v_scale`` [B, Hkv, max_len, 1]: int8-cache dequant
+    scales — scoring dequantizes on the fly (XLA fuses the multiply into
+    the einsum read); only the int8 buffers persist in HBM. The int8 path
+    stays dense (the flash kernel takes fp tiles)."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
-    if impl == "flash" and pad_lens is None:
+    if impl == "flash" and pad_lens is None and k_scale is None:
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
             return flash_attention_cached(q, k_cache, v_cache, start,
                                           scale=scale)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    if k_scale is not None:
+        kf = kf * k_scale
+        vf = vf * v_scale
     group = Hq // Hkv
     qg = q.reshape(B, S, Hkv, group, Dh)
     s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(jnp.float32),
-                   k_cache.astype(jnp.float32)) * scale
+                   kf) * scale
     key_pos = jnp.arange(max_len)                      # [K]
     q_pos = start + jnp.arange(S)                      # [S]
     mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
@@ -106,7 +156,7 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
         live = key_pos[None, None, :] >= pad_lens[:, None, None]  # [B, 1, K]
         s = jnp.where((mask[None] & live)[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhgqk,bhkd->bqhgd", p, v_cache.astype(jnp.float32))
+    o = jnp.einsum("bhgqk,bhkd->bqhgd", p, vf)
     return o.reshape(B, S, Hq, Dh).astype(q.dtype)
 
 
@@ -138,32 +188,61 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
     scale = cfg.head_dim ** -0.5
 
     x = params["embed"].astype(ad)[tokens]
+    int8 = _kv_int8(cfg)
+    if int8 != (cache.k_scale is not None):
+        raise ValueError(
+            f"kv_cache_dtype={cfg.kv_cache_dtype!r} but the cache was "
+            f"built {'WITH' if cache.k_scale is not None else 'without'} "
+            "int8 scales — cfg and init_kv_cache(cfg, ...) must agree")
+
+    def write(buf, new):
+        # new tokens arrive token-major [B, S, ., Dh']; the head-major
+        # transpose is O(S) — tiny next to the cache it writes into
+        return lax.dynamic_update_slice(
+            buf, new.transpose(0, 2, 1, 3), (0, 0, start, 0))
 
     def body(carry, layer):
         h = carry
-        lp, k_cache, v_cache = layer
+        if int8:
+            lp, k_cache, v_cache, k_scl, v_scl = layer
+        else:
+            lp, k_cache, v_cache = layer
+            k_scl = v_scl = None
 
         a = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
         q, k, v = _project_qkv(a, lp, cfg, positions)
 
-        # new tokens arrive token-major [B, S, Hkv, Dh]; the head-major
-        # transpose is O(S) — tiny next to the cache it writes into
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k.transpose(0, 2, 1, 3), (0, 0, start, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v.transpose(0, 2, 1, 3), (0, 0, start, 0))
+        if int8:
+            kq, ks_ = _quantize_kv(k)
+            vq, vs_ = _quantize_kv(v)
+            k_cache, v_cache = write(k_cache, kq), write(v_cache, vq)
+            k_scl, v_scl = write(k_scl, ks_), write(v_scl, vs_)
+        else:
+            k_cache, v_cache = write(k_cache, k), write(v_cache, v)
 
         o = _cached_attention(q, k_cache, v_cache, start, scale,
-                              impl=cfg.attn_impl, pad_lens=pad_lens)
+                              impl=cfg.attn_impl, pad_lens=pad_lens,
+                              k_scale=k_scl, v_scale=v_scl)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         h = _mlp_half(h, lp, cfg)
-        return h, (k_cache, v_cache)
+        out = ((k_cache, v_cache, k_scl, v_scl) if int8
+               else (k_cache, v_cache))
+        return h, out
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    xs = ((params["blocks"], cache.k, cache.v, cache.k_scale, cache.v_scale)
+          if int8 else (params["blocks"], cache.k, cache.v))
+    x, caches = lax.scan(body, x, xs)
     x = _rmsnorm(x, params["ln_final"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
-    return logits, KVCache(k=k_new, v=v_new, length=start + S)
+    if int8:
+        k_new, v_new, ks_new, vs_new = caches
+        new_cache = KVCache(k=k_new, v=v_new, length=start + S,
+                            k_scale=ks_new, v_scale=vs_new)
+    else:
+        k_new, v_new = caches
+        new_cache = KVCache(k=k_new, v=v_new, length=start + S)
+    return logits, new_cache
 
 
 def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
@@ -192,12 +271,24 @@ def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
 
     # scan stacks token-major [L, B, S, Hkv, Dh]; one O(S)-sized transpose
-    # to head-major, then pad the sequence dim out to max_len
+    # to head-major, then pad the sequence dim out to max_len. int8
+    # quantization applies at the STORE: the prompt window above attended
+    # full-precision k/v (slightly better than the general path, which
+    # scores against the quantized cache) — later decode steps read the
+    # quantized buffers either way.
     ks = ks.transpose(0, 1, 3, 2, 4)
     vs = vs.transpose(0, 1, 3, 2, 4)
     pad = [(0, 0), (0, 0), (0, 0), (0, max_len - S), (0, 0)]
-    cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
-                    length=jnp.asarray(S, jnp.int32))
+    if _kv_int8(cfg):
+        kq, kscl = _quantize_kv(ks)
+        vq, vscl = _quantize_kv(vs)
+        cache = KVCache(k=jnp.pad(kq, pad), v=jnp.pad(vq, pad),
+                        length=jnp.asarray(S, jnp.int32),
+                        k_scale=jnp.pad(kscl, pad),
+                        v_scale=jnp.pad(vscl, pad))
+    else:
+        cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
+                        length=jnp.asarray(S, jnp.int32))
     return logits, cache
 
 
@@ -221,7 +312,11 @@ def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
     return logits[:, -1], cache
 
 
-_cached_forward_jit = jax.jit(cached_forward, static_argnums=(3,))
+# cache donation: each chunk's update reuses the cache buffers in place —
+# without it every chunk holds input+output copies of the full-size cache,
+# doubling peak HBM in exactly the near-capacity regime chunking targets
+_cached_forward_jit = jax.jit(cached_forward, static_argnums=(3,),
+                              donate_argnums=(2,))
 
 
 def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
@@ -235,7 +330,8 @@ def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
     mask, evaluated piecewise. Each piece runs through a jitted
     cached_forward, so at most two programs compile (full chunk +
     remainder). Call it EAGERLY — under an outer jit the loop unrolls into
-    one trace that grows with S/chunk."""
+    one trace that grows with S/chunk. The input ``cache`` is DONATED
+    (updated in place on device); don't reuse the passed-in object."""
     B, S = prompt.shape
     if S == 0 or chunk <= 0:
         raise ValueError(f"need a non-empty prompt (S={S}) and a positive "

@@ -49,6 +49,9 @@ class LlamaConfig:
     seq_schedule: str = "ring"     # "ring" | "zigzag" (balanced causal ring)
     attn_impl: str = "dense"       # "dense" | "flash" (pallas kernel; falls
                                    # back to dense off-TPU / non-tiling shapes)
+    kv_cache_dtype: str = "auto"   # "auto" (= act dtype) | "int8" (quantized
+                                   # serving cache: half the HBM, on-the-fly
+                                   # dequant — models/decode.py)
 
     @property
     def head_dim(self) -> int:
