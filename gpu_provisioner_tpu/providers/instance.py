@@ -150,10 +150,13 @@ class InstanceProvider:
         self.queued = queued
         self.kube = kube
         self.cfg = config or ProviderConfig()
-        self._pool_snapshot: Optional[tuple[float, list[NodePool]]] = None
+        # (timestamp, pools, {group: claim-name fingerprint at list time})
+        self._pool_snapshot: Optional[
+            tuple[float, list[NodePool], dict[str, frozenset]]] = None
         self._pool_snapshot_lock = asyncio.Lock()
 
-    async def _pools_snapshot(self) -> list[NodePool]:
+    async def _pools_snapshot(self, group: str,
+                              claim_names: frozenset) -> list[NodePool]:
         """Pool listing for slice-group identity reads, memoized for
         POOL_SNAPSHOT_TTL with single-flight: a concurrent wave of grouped
         creates does ONE cloud LIST per burst instead of one per member
@@ -164,15 +167,28 @@ class InstanceProvider:
         deterministic: a member whose just-stamped pool is missing from the
         snapshot is re-derived from the same (creationTimestamp, name)
         NodeClaim order every racing reconciler uses, yielding the same
-        index (see _slice_group_identity). Stickiness only has to survive
+        index (see _slice_group_identity). That argument requires the
+        group's CLAIM SET to be stable across the window — a member deleted
+        mid-burst shrinks the order and a survivor could re-derive a
+        colliding index. So each snapshot records the claim-name
+        fingerprint per group at list time and a read whose live fingerprint
+        differs (or was never recorded) forces a refresh; the stable-set
+        burst still costs one LIST. Stickiness only has to survive
         restarts, which outlive any 1s snapshot."""
         async with self._pool_snapshot_lock:
             now_s = asyncio.get_event_loop().time()
-            if (self._pool_snapshot is not None
-                    and now_s - self._pool_snapshot[0] < self.POOL_SNAPSHOT_TTL):
-                return self._pool_snapshot[1]
+            snap = self._pool_snapshot
+            if (snap is not None and now_s - snap[0] < self.POOL_SNAPSHOT_TTL
+                    and snap[2].get(group) == claim_names):
+                return snap[1]
             pools = await self.nodepools.list()
-            self._pool_snapshot = (now_s, pools)
+            # merge, don't replace: other groups' fingerprints stay valid
+            # against the strictly-newer pool list (their claim sets are
+            # re-certified live on their next read), so concurrent bursts
+            # across groups still share one LIST instead of thrashing
+            prev = snap[2] if snap is not None else {}
+            self._pool_snapshot = (now_s, pools,
+                                   {**prev, group: claim_names})
             return pools
 
     # ------------------------------------------------------------- create
@@ -269,7 +285,12 @@ class InstanceProvider:
         if not group:
             return {}
 
-        pools = await self._pools_snapshot()
+        # claims FIRST (live/informer read): their name-set is the
+        # freshness fingerprint the pool snapshot is validated against
+        claims = await self.kube.list(
+            NodeClaim, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+        pools = await self._pools_snapshot(
+            group, frozenset(c.metadata.name for c in claims))
         used: dict[int, str] = {}          # stamped index -> pool name
         for p in pools:
             if p.config.labels.get(wk.TPU_SLICE_GROUP_LABEL) != group:
@@ -279,9 +300,6 @@ class InstanceProvider:
                 used[int(idx)] = p.name
 
         mine = next((i for i, n in used.items() if n == nc.metadata.name), None)
-
-        claims = await self.kube.list(
-            NodeClaim, labels={wk.TPU_SLICE_GROUP_LABEL: group})
         ordered = sorted(claims, key=lambda c: (
             fmt_time(c.metadata.creation_timestamp)
             if c.metadata.creation_timestamp else "", c.metadata.name))
@@ -477,7 +495,13 @@ class InstanceProvider:
                 if not e.not_found:
                     raise
         try:
+            # belt-and-braces: the claim-set fingerprint in _pools_snapshot
+            # is the primary freshness guard (a departed member changes the
+            # live claim list); dropping the snapshot on OUR OWN pool
+            # deletes closes the narrow window where the pool is gone but
+            # the claim briefly remains
             op = await self.nodepools.begin_delete(name)
+            self._pool_snapshot = None
             await poll_until_done(op)
         except APIError as e:
             if e.not_found:

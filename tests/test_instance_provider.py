@@ -7,6 +7,7 @@ import pytest
 
 from gpu_provisioner_tpu.apis import labels as wk
 from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
 from gpu_provisioner_tpu.cloudprovider.errors import (
     CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
 )
@@ -296,6 +297,32 @@ async def test_multislice_identity_concurrent_create_storm():
         ident = await provider._slice_group_identity(c)
         assert int(ident[wk.TPU_SLICE_INDEX_LABEL]) == idx[c.metadata.name]
     assert calls["lists"] <= 3, calls
+
+
+@async_test
+async def test_multislice_identity_survives_member_deletion_mid_burst():
+    """A member deleted inside the snapshot TTL must not make a later
+    member re-derive a colliding index from the shrunken claim order — the
+    per-group claim-name FINGERPRINT forces a snapshot refresh whenever the
+    live claim set differs from the one recorded at list time (plus a
+    belt-and-braces drop on the provider's own pool deletes), so the
+    survivor sees the stamped pools fresh (code-review r4 finding)."""
+    kube, cloud, provider = setup()
+    claims = [make_nodeclaim(f"del{i}", "tpu-v5e-16",
+                             labels={wk.TPU_SLICE_GROUP_LABEL: "gd"})
+              for i in range(3)]
+    for c in claims:
+        await kube.create(c)
+    await provider.create(claims[0])              # del0 → 0
+    await provider.create(claims[1])              # del1 → 1
+    await kube.delete(NodeClaim, "del0")          # member leaves the group
+    await provider.delete("del0")                 # (claim AND pool)
+    await provider.create(claims[2])              # must not collide with 1
+    idx = {n: p.config.labels[wk.TPU_SLICE_INDEX_LABEL]
+           for n, p in cloud.nodepools.pools.items()}
+    assert idx["del1"] == "1"                     # sticky
+    assert idx["del2"] != idx["del1"]             # no collision
+    assert idx["del2"] == "0"                     # lowest free index reused
 
 
 @async_test
