@@ -127,17 +127,17 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     kernel masks by position only).
 
     ``k_scale``/``v_scale`` [B, Hkv, max_len, 1]: int8-cache dequant
-    scales — scoring dequantizes on the fly (XLA fuses the multiply into
-    the einsum read); only the int8 buffers persist in HBM. The int8 path
-    stays dense (the flash kernel takes fp tiles)."""
+    scales. The flash kernel dequantizes IN VMEM (only int8 bytes cross
+    HBM); the dense path dequantizes in the read einsum."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
-    if impl == "flash" and pad_lens is None and k_scale is None:
+    if impl == "flash" and pad_lens is None:
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
             return flash_attention_cached(q, k_cache, v_cache, start,
-                                          scale=scale)
+                                          scale=scale, k_scale=k_scale,
+                                          v_scale=v_scale)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if k_scale is not None:

@@ -114,13 +114,21 @@ def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
 
 def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
                          q_pos0, kv_pos0, block_q, block_k, scale, masked):
+    """One flash tile from refs — see _online_softmax_tile."""
+    _online_softmax_tile(
+        q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
+        v_ref[0].astype(jnp.float32), acc_ref, m_ref, l_ref,
+        q_pos0=q_pos0, kv_pos0=kv_pos0, block_q=block_q, block_k=block_k,
+        scale=scale, masked=masked)
+
+
+def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
+                         q_pos0, kv_pos0, block_q, block_k, scale, masked):
     """One flash tile: S = qKᵀ·scale (masked below q_pos0+i ≥ kv_pos0+j when
     ``masked``), then the running-max/denominator update into VMEM scratch.
-    Shared by the streaming self-attention and KV-cache kernels so numerics
-    fixes land in one place."""
-    q = q_ref[0].astype(jnp.float32)                  # [BQ, D]
-    k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
+    Shared by the streaming self-attention and KV-cache kernels (incl. the
+    int8 variant, which dequantizes before calling) so numerics fixes land
+    in one place. q/k/v are f32 tile VALUES [BQ|BK, D]."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [BQ, BK]
@@ -381,8 +389,8 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
 
 # --- KV-cache (serving) forward --------------------------------------------
 
-def _kernel_cached(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, block_q, block_k, scale):
+def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
+                   scale, int8):
     """Streaming flash where the query block sits at cache positions
     ``start + qi·BQ ..`` against a [max_len]-wide KV cache. ``start`` is a
     traced scalar riding as a scalar-prefetch argument so both the mask and
@@ -390,7 +398,15 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
     ≤ the query block's last position — everything past the causal frontier
     (which also bounds the written prefix, since the new tokens' keys are
     written before scoring — models/decode.py cached_forward) is neither
-    computed nor fetched."""
+    computed nor fetched.
+
+    ``int8``: k/v arrive quantized with per-token scale refs trailing them
+    (models/decode.py int8 cache) — tiles dequantize in VMEM, so only the
+    int8 buffers travel over HBM (the bandwidth win is the point)."""
+    if int8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -404,10 +420,18 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
     @pl.when(live)
     def _step():
-        _online_softmax_step(
-            q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
-            q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
-            block_q=block_q, block_k=block_k, scale=scale, masked=True)
+        if int8:
+            k = k_ref[0].astype(jnp.float32) * ks_ref[0]
+            v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+            _online_softmax_tile(
+                q_ref[0].astype(jnp.float32), k, v, acc_ref, m_ref, l_ref,
+                q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
+                block_q=block_q, block_k=block_k, scale=scale, masked=True)
+        else:
+            _online_softmax_step(
+                q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
+                block_q=block_q, block_k=block_k, scale=scale, masked=True)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -427,7 +451,8 @@ def cached_flash_supported(S: int, max_len: int, Hq: int, Hkv: int,
 
 def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
                            block_q: int = None, block_k: int = None,
-                           interpret: bool = None):
+                           interpret: bool = None,
+                           k_scale=None, v_scale=None):
     """Flash attention of fresh-token queries against a KV cache — the
     serving prefill-continuation path (forward-only, no VJP; decode never
     differentiates). Replaces the dense S×max_len masked sweep of
@@ -442,6 +467,11 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     positions start..start+S-1 already written; ``start``: traced int32
     scalar. Returns [B, S, Hq, D]. Callers must gate on
     cached_flash_supported().
+
+    ``k_scale``/``v_scale`` [B, Hkv, max_len, 1] f32: int8-cache mode —
+    k_cache/v_cache are int8 and tiles dequantize IN VMEM, so only the
+    int8 bytes cross HBM (the quantized cache's bandwidth win carries into
+    the kernel instead of falling back to the dense sweep).
 
     Sharding note: under a tensor-parallel mesh the GSPMD partitioner cannot
     split a pallas_call, so a kv-head-sharded cache is gathered around the
@@ -473,14 +503,24 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     kv_idx = _causal_kv_index(block_q, block_k, group, True,
                               prefetch_start=True)
 
+    int8 = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), q_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, kf, vf]
+    if int8:
+        sspec = pl.BlockSpec((1, block_k, 1), kv_idx,
+                             memory_space=pltpu.VMEM)
+        in_specs += [sspec, sspec]
+        operands += [k_scale.reshape(B * Hkv, ML, 1),
+                     v_scale.reshape(B * Hkv, ML, 1)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B * Hq, S // block_q, ML // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), q_idx, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), q_idx,
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -491,11 +531,11 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     )
     out = pl.pallas_call(
         functools.partial(_kernel_cached, block_q=block_q, block_k=block_k,
-                          scale=scale),
+                          scale=scale, int8=int8),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
         interpret=interpret,
-    )(start_arr, qf, kf, vf)
+    )(start_arr, *operands)
     return _rows_to_heads(out, B, Hq)
 
 

@@ -185,10 +185,17 @@ class InstanceProvider:
             # merge, don't replace: other groups' fingerprints stay valid
             # against the strictly-newer pool list (their claim sets are
             # re-certified live on their next read), so concurrent bursts
-            # across groups still share one LIST instead of thrashing
+            # across groups still share one LIST instead of thrashing.
+            # Prune fingerprints of groups with no pools left — a
+            # long-lived provider churning through short-lived groups must
+            # not accumulate dead entries forever (a pruned-but-live group
+            # merely refreshes on its next read).
+            live = {p.config.labels.get(wk.TPU_SLICE_GROUP_LABEL)
+                    for p in pools}
             prev = snap[2] if snap is not None else {}
-            self._pool_snapshot = (now_s, pools,
-                                   {**prev, group: claim_names})
+            fps = {g: fp for g, fp in prev.items() if g in live}
+            fps[group] = claim_names
+            self._pool_snapshot = (now_s, pools, fps)
             return pools
 
     # ------------------------------------------------------------- create
@@ -495,14 +502,17 @@ class InstanceProvider:
                 if not e.not_found:
                     raise
         try:
+            op = await self.nodepools.begin_delete(name)
+            await poll_until_done(op)
             # belt-and-braces: the claim-set fingerprint in _pools_snapshot
             # is the primary freshness guard (a departed member changes the
             # live claim list); dropping the snapshot on OUR OWN pool
             # deletes closes the narrow window where the pool is gone but
-            # the claim briefly remains
-            op = await self.nodepools.begin_delete(name)
-            self._pool_snapshot = None
-            await poll_until_done(op)
+            # the claim briefly remains. AFTER the poll and UNDER the lock:
+            # dropped earlier, an in-flight refresh could list the dying
+            # pool and overwrite the invalidation with pre-delete state.
+            async with self._pool_snapshot_lock:
+                self._pool_snapshot = None
         except APIError as e:
             if e.not_found:
                 raise NodeClaimNotFoundError(f"nodepool {name} not found") from e

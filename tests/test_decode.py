@@ -98,14 +98,20 @@ def test_fresh_prefill_fast_path_matches_general():
                                atol=3e-2, rtol=3e-2)
 
 
-def test_multiturn_flash_prefill_matches_dense():
+import pytest
+
+
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_multiturn_flash_prefill_matches_dense(kv_dtype):
     """Multi-turn serving: prefill a block-sized prompt, decode a few, then
     prefill a second turn — attn_impl="flash" (cache-aware Pallas kernel on
     the S≥128 turns, dense on S=1 steps) must match attn_impl="dense"
-    end-to-end on logits, cache contents, and length."""
+    end-to-end on logits, cache contents, and length. Parametrized over the
+    fp and int8 cache modes (the kernel dequantizes in VMEM for the
+    latter)."""
     import dataclasses
 
-    cfg_d = dataclasses.replace(CFG, max_seq_len=512)
+    cfg_d = dataclasses.replace(CFG, max_seq_len=512, kv_cache_dtype=kv_dtype)
     cfg_f = dataclasses.replace(cfg_d, attn_impl="flash")
     params = init_params(jax.random.key(0), cfg_d)
     turn1 = jax.random.randint(jax.random.key(1), (2, 128), 0,
@@ -130,8 +136,16 @@ def test_multiturn_flash_prefill_matches_dense():
     np.testing.assert_allclose(np.asarray(l2f), np.asarray(l2d),
                                atol=3e-2, rtol=3e-2)
     assert int(cf.length) == int(cd.length) == 258
-    np.testing.assert_allclose(np.asarray(cf.k.astype(jnp.float32)),
-                               np.asarray(cd.k.astype(jnp.float32)),
+    # compare caches in VALUE space: int8 mode stores quanta, and upstream
+    # bf16 noise can flip a rounding boundary by one unit — dequantized
+    # values are what attention consumes. Both halves: k and v travel
+    # separate quantize/write/dequant paths.
+    def deq(buf, scl):
+        return (np.asarray(buf.astype(jnp.float32))
+                * (np.asarray(scl) if scl is not None else 1.0))
+    np.testing.assert_allclose(deq(cf.k, cf.k_scale), deq(cd.k, cd.k_scale),
+                               atol=3e-2, rtol=3e-2)
+    np.testing.assert_allclose(deq(cf.v, cf.v_scale), deq(cd.v, cd.v_scale),
                                atol=3e-2, rtol=3e-2)
 
 

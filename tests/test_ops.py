@@ -155,6 +155,34 @@ def test_cached_flash_under_jit_traced_start():
                                    np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_cached_flash_int8_matches_dense_dequant():
+    """int8-cache kernel mode (in-VMEM dequant) vs the dense dequantizing
+    sweep it replaces."""
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    # token-major [B, ML, Hkv, D] → quantize → head-major cache layout
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    kc, vc = hm(kq), hm(vq)
+    ksc, vsc = hm(kscl), hm(vscl)
+    start = jnp.asarray(130, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_cached(q, kc, vc, start, scale=scale,
+                                 k_scale=ksc, v_scale=vsc)
+    ref = _cached_attention(q, kc, vc, start, scale,
+                            k_scale=ksc, v_scale=vsc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_cached_flash_supported_gates():
     from gpu_provisioner_tpu.ops.flash_attention import cached_flash_supported
     assert cached_flash_supported(128, 512, 4, 2)

@@ -131,12 +131,20 @@ def test_flash_kernels_lower_on_chip():
     vc = jax.random.normal(ks[2], (1, 2, 2048, 128), jnp.bfloat16)
     cached = flash_attention_cached(q[:, :128], kc, vc,
                                     jnp.asarray(17, jnp.int32))
+    # int8-cache kernel mode (in-VMEM dequant; scale blocks are the
+    # (1, block, 1) shape the tiling rule only accepts as rank-3)
+    kc8 = (kc * 31).astype(jnp.int8)
+    vc8 = (vc * 31).astype(jnp.int8)
+    scl = jnp.full((1, 2, 2048, 1), 1 / 31.0, jnp.float32)
+    cached8 = flash_attention_cached(q[:, :128], kc8, vc8,
+                                     jnp.asarray(17, jnp.int32),
+                                     k_scale=scl, v_scale=scl)
     # streaming variants: the default rectangular grid AND the opt-in
     # triangular grid (S=16384 exceeds the residency budget → streaming)
     qs, ks_, vs = (jnp.tile(x, (1, 16, 1, 1)) for x in (q, k, v))
     stream = flash_attention(qs, ks_, vs)
     tri = flash_attention(qs, ks_, vs, triangular=True)
-    for x in (out, g, g_tri, cached, stream, tri):
+    for x in (out, g, g_tri, cached, cached8, stream, tri):
         for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
             assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
     # value-level sign-off for the triangular grids (the docstring's gate
