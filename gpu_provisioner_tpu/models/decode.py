@@ -367,7 +367,8 @@ def _filter_top_p(logits, top_p: float):
 def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
              max_len: int = None, temperature: float = 0.0,
              top_k: int = None, top_p: float = None, key=None,
-             pad_id: int = None, eos_id: int = None):
+             pad_id: int = None, eos_id: int = None,
+             return_logprobs: bool = False):
     """Autoregressive generation: prefill, then ONE lax.scan of decode
     steps. prompt: [B, S0] int32 → [B, max_new_tokens] int32.
 
@@ -388,7 +389,13 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     that row comes back as eos_id (the scan runs to max_new_tokens; XLA
     has no early exit, finished rows just stop contributing real tokens —
     the HF unfinished_sequences convention, so downstream truncation is a
-    simple == eos_id scan)."""
+    simple == eos_id scan).
+
+    ``return_logprobs``: also return each emitted token's log-probability
+    under the FINAL sampling distribution (post temperature/top-k/top-p —
+    what the sampler actually drew from; greedy reports the unfiltered
+    distribution) as a second [B, max_new_tokens] f32 array. Positions
+    forced to eos by row finishing report 0.0."""
     B, S0 = prompt.shape
     if max_len is None:
         max_len = S0 + max_new_tokens
@@ -415,31 +422,46 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
                             fresh=pad_id is None, pad_lens=pad_lens)
 
     def pick(logits, key):
-        if temperature <= 0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k is not None:
-            logits = _filter_top_k(logits, top_k)
-        if top_p is not None:
-            logits = _filter_top_p(logits, top_p)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        """(token, logprob-under-the-sampling-distribution) per row."""
+        if temperature > 0:
+            logits = logits / temperature
+            if top_k is not None:
+                logits = _filter_top_k(logits, top_k)
+            if top_p is not None:
+                logits = _filter_top_p(logits, top_p)
+            tok = jax.random.categorical(key, logits,
+                                         axis=-1).astype(jnp.int32)
+        else:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not return_logprobs:      # static flag — don't pay a full-vocab
+            return tok, jnp.zeros(tok.shape, jnp.float32)  # softmax in eager
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                 tok[:, None], axis=-1)[:, 0]
+        return tok, lp
 
     keys = (jax.random.split(key, max_new_tokens) if temperature > 0
             else jnp.zeros((max_new_tokens,)))
     # first token comes straight from the prefill logits; the scan then does
     # forward-then-pick, so no decode forward is ever computed and discarded
-    tok0 = pick(logits, keys[0])
+    tok0, lp0 = pick(logits, keys[0])
     done0 = (tok0 == eos_id) if eos_id is not None else None
 
     def step(carry, key_t):
         tok, done, cache = carry
         new_logits, cache = cached_forward(params, tok[:, None], cache, cfg,
                                            pad_lens=pad_lens)
-        nxt = pick(new_logits[:, 0], key_t)
+        nxt, lp = pick(new_logits[:, 0], key_t)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            lp = jnp.where(done, 0.0, lp)    # forced eos: not a model draw
             done = done | (nxt == eos_id)
-        return (nxt, done, cache), nxt
+        return (nxt, done, cache), (nxt, lp)
 
-    (_, _, _), rest = lax.scan(step, (tok0, done0, cache), keys[1:])
-    return jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)
+    (_, _, _), (rest, rest_lp) = lax.scan(step, (tok0, done0, cache),
+                                          keys[1:])
+    toks = jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)
+    if not return_logprobs:
+        return toks
+    logprobs = jnp.concatenate([lp0[:, None], rest_lp.transpose(1, 0)],
+                               axis=1)
+    return toks, logprobs

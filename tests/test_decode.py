@@ -326,6 +326,33 @@ def test_left_padded_ragged_batch_matches_unpadded():
                                   np.asarray(solo_long[0]))
 
 
+def test_generate_returns_logprobs():
+    """return_logprobs: greedy logprobs equal log_softmax at the argmax of
+    a stepwise reference; tokens unchanged vs the plain call; sampled-mode
+    logprobs are finite, ≤ 0, and keyed reproducibly."""
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)
+    toks_plain = generate(params, prompt, CFG, max_new_tokens=3)
+    toks, lps = generate(params, prompt, CFG, max_new_tokens=3,
+                         return_logprobs=True)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks_plain))
+    assert lps.shape == (2, 3) and bool(jnp.all(lps <= 0))
+    # reference: stepwise full-forward greedy logprob of the first token
+    ref_logits = forward(params, prompt, CFG)[:, -1]
+    ref_lp = jax.nn.log_softmax(ref_logits, -1)[
+        jnp.arange(2), jnp.argmax(ref_logits, -1)]
+    np.testing.assert_allclose(np.asarray(lps[:, 0]), np.asarray(ref_lp),
+                               atol=3e-2, rtol=3e-2)
+
+    kw = dict(max_new_tokens=3, temperature=0.8, top_k=16,
+              return_logprobs=True)
+    t1, l1 = generate(params, prompt, CFG, **kw, key=jax.random.key(7))
+    t2, l2 = generate(params, prompt, CFG, **kw, key=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    assert bool(jnp.all(jnp.isfinite(l1))) and bool(jnp.all(l1 <= 0))
+
+
 def test_generate_sampling_reproducible_and_in_vocab():
     params = init_params(jax.random.key(0), CFG)
     prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, CFG.vocab_size)
