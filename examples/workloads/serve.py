@@ -65,14 +65,22 @@ def main():
     print("sampled:", sampled[0].tolist())
 
     # ragged batch: left-pad mixed-length prompts (pad_id), finish rows at
-    # eos (eos_id) — each padded row generates exactly what it would alone
+    # eos (eos_id), report per-token logprobs — each padded row generates
+    # exactly what it would alone
     short = prompt[:1, :6]
     ragged = jnp.concatenate(
         [jnp.concatenate([jnp.zeros((1, 10), short.dtype), short], 1),
          prompt[1:, :16]], 0)
-    out = generate(params, ragged, cfg, max_new_tokens=8, pad_id=0,
-                   eos_id=int(greedy[0, -1]))
+    out, lps = generate(params, ragged, cfg, max_new_tokens=8, pad_id=0,
+                        eos_id=int(greedy[0, -1]), return_logprobs=True)
     print("ragged :", out.tolist())
+    print("logprob:", [round(float(x), 2) for x in lps[0]])
+
+    # memory-constrained serving: int8 cache (half the HBM) — same API
+    from dataclasses import replace as _replace
+    cfg8 = _replace(cfg, kv_cache_dtype="int8")
+    out8 = generate(params, prompt, cfg8, max_new_tokens=8)
+    print("int8   :", out8[0].tolist())
 
     # multi-turn: turn-1 prefill → decode 2 → turn-2 prefill continues the
     # SAME cache (flash-kernel path for block-sized turns under
