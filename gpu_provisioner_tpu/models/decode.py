@@ -116,21 +116,31 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     cache-aware Pallas kernel (ops/flash_attention.py:flash_attention_cached)
     — blocks past the causal frontier are neither computed nor DMA'd, so
     continuing a partially-filled cache stops paying the dense S×max_len
-    sweep. S=1 decode steps always use the dense path (a GEMV-shaped op the
-    kernel can't tile).
+    sweep. S=1 decode steps take the dedicated decode kernel
+    (flash_attention_decode): one fetch of each kv head's live prefix
+    serves all its GQA queries, and a step costs O(start) HBM traffic
+    instead of the dense sweep's O(max_len) (pad_lens supported in-kernel).
 
     k_cache/v_cache: [B, Hkv, max_len, Dh] head-major (one layer's slice).
 
     ``pad_lens`` [B] (left-padded ragged batches — the standard serving
     layout): row b's cache positions [0, pad_lens[b]) hold pad tokens that
-    no query may attend to. Pad rows stay on the dense path (the flash
-    kernel masks by position only).
+    no query may attend to. S=1 steps mask pads in the decode kernel;
+    padded PREFILL rows stay on the dense path (the prefill kernel masks
+    by position only).
 
     ``k_scale``/``v_scale`` [B, Hkv, max_len, 1]: int8-cache dequant
     scales. The flash kernel dequantizes IN VMEM (only int8 bytes cross
     HBM); the dense path dequantizes in the read einsum."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
+    if impl == "flash" and S == 1:
+        from ..ops.flash_attention import (decode_flash_supported,
+                                           flash_attention_decode)
+        if decode_flash_supported(max_len, Hq, Hkv):
+            return flash_attention_decode(q, k_cache, v_cache, start,
+                                          scale=scale, k_scale=k_scale,
+                                          v_scale=v_scale, pad_lens=pad_lens)
     if impl == "flash" and pad_lens is None:
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)

@@ -138,6 +138,14 @@ def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
         kv_pos = kv_pos0 + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    _online_update(s, v, acc_ref, m_ref, l_ref)
+
+
+def _online_update(s, v, acc_ref, m_ref, l_ref):
+    """Running-max/denominator update from an already-masked score tile —
+    the numerics core shared by every forward kernel (self-attention,
+    KV-cache prefill, and the decode-step kernel, whose row-uniform mask
+    doesn't fit _online_softmax_tile's per-row iota)."""
     m_prev, l_prev = m_ref[:], l_ref[:]
     m_blk = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
@@ -537,6 +545,153 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
         interpret=interpret,
     )(start_arr, *operands)
     return _rows_to_heads(out, B, Hq)
+
+
+# --- KV-cache decode step (S = 1) ------------------------------------------
+
+def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
+                   scale, int8, padded):
+    """One generated token's attention against the cache: grid row bh owns
+    kv head ``bh % Hkv`` of batch ``bh // Hkv`` and computes ALL ``group``
+    of its GQA queries in one pass — the cache tile is fetched once per kv
+    head (the dense sweep and a per-q-head grid both read it group× more).
+    ``meta_ref`` (SMEM scalar prefetch): [start, pad_len_0..pad_len_B-1];
+    every query sits at position ``start``, so the mask is row-uniform:
+    pad_len ≤ key position ≤ start. Blocks outside that window are neither
+    computed (the ``live`` gate) nor fetched (the clamped index map)."""
+    if int8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    kj = pl.program_id(1)
+    n_kv = pl.num_programs(1)
+    start = meta_ref[0]
+    pad = meta_ref[1 + pl.program_id(0) // Hkv] if padded else 0
+
+    @pl.when(kj == 0)
+    def _init():
+        _init_softmax_scratch(acc_ref, m_ref, l_ref)
+
+    live = kj * block_k <= start
+    if padded:
+        live = live & ((kj + 1) * block_k - 1 >= pad)
+
+    @pl.when(live)
+    def _step():
+        if int8:
+            k = k_ref[0].astype(jnp.float32) * ks_ref[0]
+            v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+        else:
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)              # [group, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [group, BK]
+        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = kv_pos <= start
+        if padded:
+            mask = mask & (kv_pos >= pad)
+        _online_update(jnp.where(mask, s, NEG_INF), v, acc_ref, m_ref, l_ref)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        _finalize_out(o_ref, acc_ref, m_ref, l_ref)
+
+
+def decode_flash_supported(max_len: int, Hq: int, Hkv: int,
+                           block_k: int = None) -> bool:
+    """True iff flash_attention_decode can take these shapes (max_len tiles
+    into ≥128-aligned kv blocks, GQA divides)."""
+    bk = _auto_block(max_len, block_k)
+    return max_len % bk == 0 and bk >= 128 and Hq % Hkv == 0
+
+
+def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
+                           block_k: int = None, interpret: bool = None,
+                           k_scale=None, v_scale=None, pad_lens=None):
+    """The serving decode step as a Pallas kernel: ONE new token per row
+    ([B, 1, Hq, D] queries at cache position ``start``) against a
+    [B, Hkv, max_len, D] head-major cache (forward-only; decode never
+    differentiates).
+
+    Replaces models/decode.py:_cached_attention's S=1 dense sweep, which
+    XLA must compute over the FULL static max_len width because ``start``
+    is traced. Here ``start`` rides as scalar prefetch into the kv index
+    map, so blocks past the live prefix are never DMA'd: a step costs
+    O(start), not O(max_len) — at a 4k serving budget with a 512-token
+    prompt that is ~7× less cache traffic, and the decode step is pure
+    HBM bandwidth. GQA doubles down: grid rows are (batch, kv head), each
+    fetching its cache tile ONCE for all ``group`` queries (the dense
+    sweep's einsum reads it per q-head from HBM at small B).
+
+    ``k_scale``/``v_scale``: int8-cache mode, dequantized in VMEM as in
+    flash_attention_cached. ``pad_lens`` [B] int32: left-padded ragged
+    batches — row b may only attend to positions ≥ pad_lens[b]; leading
+    all-pad blocks are likewise skipped and un-fetched. Callers gate on
+    decode_flash_supported()."""
+    B, S, Hq, D = q.shape
+    assert S == 1, f"decode kernel is single-token; got S={S}"
+    Hkv, ML = k_cache.shape[1], k_cache.shape[2]
+    group = Hq // Hkv
+    if scale is None:
+        scale = D ** -0.5
+    block_k = _auto_block(ML, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # head h = (h // group)-th kv head, (h % group)-th query of its group —
+    # the same grouping _cached_attention's reshape uses
+    qf = q.reshape(B * Hkv, group, D)
+    kf = k_cache.reshape(B * Hkv, ML, D)
+    vf = v_cache.reshape(B * Hkv, ML, D)
+    padded = pad_lens is not None
+    meta = jnp.asarray(start, jnp.int32).reshape(1)
+    if padded:
+        meta = jnp.concatenate([meta, pad_lens.astype(jnp.int32)])
+
+    def kv_idx(bh, kj, meta_ref):
+        lo = meta_ref[1 + bh // Hkv] // block_k if padded else 0
+        hi = meta_ref[0] // block_k
+        return (bh, jnp.clip(kj, lo, hi), 0)
+
+    q_idx = lambda bh, kj, meta_ref: (bh, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, group, D), q_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, kf, vf]
+    int8 = k_scale is not None
+    if int8:
+        sspec = pl.BlockSpec((1, block_k, 1), kv_idx,
+                             memory_space=pltpu.VMEM)
+        in_specs += [sspec, sspec]
+        operands += [k_scale.reshape(B * Hkv, ML, 1),
+                     v_scale.reshape(B * Hkv, ML, 1)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * Hkv, ML // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, group, D), q_idx,
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((group, D), jnp.float32),     # acc
+            pltpu.VMEM((group, 1), jnp.float32),     # running max
+            pltpu.VMEM((group, 1), jnp.float32),     # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_decode, Hkv=Hkv, group=group,
+                          block_k=block_k, scale=scale, int8=int8,
+                          padded=padded),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, group, D), q.dtype),
+        interpret=interpret,
+    )(meta, *operands)
+    return out.reshape(B, 1, Hq, D)
 
 
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------

@@ -183,6 +183,118 @@ def test_cached_flash_int8_matches_dense_dequant():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("start", [0, 37, 130, 511])
+def test_decode_flash_matches_dense_sweep(start):
+    """flash_attention_decode (S=1, scalar-prefetch start, per-kv-head grid)
+    vs the dense masked sweep it replaces."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        decode_flash_supported, flash_attention_decode)
+
+    B, ML, Hq, Hkv, D = 2, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))    # head-major
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    assert decode_flash_supported(ML, Hq, Hkv)
+    scale = D ** -0.5
+    s = jnp.asarray(start, jnp.int32)
+    out = flash_attention_decode(q, kc, vc, s, scale=scale)
+    ref = _cached_attention(q, kc, vc, s, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_padded_rows_match_dense():
+    """pad_lens in-kernel: row b attends only to positions ≥ pad_lens[b]
+    (left-padded ragged serving); leading all-pad blocks are skipped."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 3, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    pad = jnp.asarray([0, 7, 300], jnp.int32)
+    scale = D ** -0.5
+    s = jnp.asarray(384, jnp.int32)
+    out = flash_attention_decode(q, kc, vc, s, scale=scale, pad_lens=pad)
+    ref = _cached_attention(q, kc, vc, s, scale, pad_lens=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_int8_matches_dense_dequant():
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 2, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(13), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    s = jnp.asarray(200, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_decode(q, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl))
+    ref = _cached_attention(q, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_int8_padded_matches_dense():
+    """int8 cache × left-padded ragged rows — the scale refs ride AFTER the
+    kv refs while the pad mask indexes the prefetched meta; the combination
+    must stay wired (a supported serving config: quantized cache server
+    taking ragged batches)."""
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 3, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(15), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    pad = jnp.asarray([0, 37, 300], jnp.int32)
+    s = jnp.asarray(384, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_decode(q, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl),
+                                 pad_lens=pad)
+    ref = _cached_attention(q, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl),
+                            pad_lens=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_under_jit_traced_start():
+    """start is traced in generate's scan — the kernel must accept it."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 1, 256, 2, 1, 32
+    ks = jax.random.split(jax.random.key(14), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    f = jax.jit(lambda s: flash_attention_decode(q, kc, vc, s))
+    for s in (0, 65, 255):
+        ref = _cached_attention(q, kc, vc, jnp.asarray(s), D ** -0.5)
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray(s, jnp.int32))),
+                                   np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_cached_flash_supported_gates():
     from gpu_provisioner_tpu.ops.flash_attention import cached_flash_supported
     assert cached_flash_supported(128, 512, 4, 2)
