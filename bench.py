@@ -397,15 +397,15 @@ def bench_flash_op(fast: bool) -> dict:
         x.block_until_ready()
         return float(x[0, 0, 0, 0])
 
-    def timeit(fn):
+    def timeit(fn, settle_fn=settle):
         f = jax.jit(fn)
-        settle(f(q, k, v))
+        settle_fn(f(q, k, v))
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
             for _ in range(5):
                 out = f(q, k, v)
-            settle(out)
+            settle_fn(out)
             best = min(best, (time.perf_counter() - t0) / 5)
         return best * 1e3
 
@@ -413,6 +413,25 @@ def bench_flash_op(fast: bool) -> dict:
     dense_ms = timeit(lambda *a: dense_attention(*a))
     out = {"seq_len": S, "flash_ms": flash_ms, "dense_ms": dense_ms,
            "flash_speedup": dense_ms / flash_ms}
+
+    # fwd+bwd: the training path (per-block-recompute Pallas backward vs
+    # dense autodiff) — round-3's 4.6× claim, driver-re-verifiable here
+    def vjp_of(attn):
+        def f(*a):
+            return jnp.sum(attn(*a).astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    def settle_g(g):
+        g[0].block_until_ready()
+        return float(g[0][0, 0, 0, 0])
+
+    try:
+        out["flash_fwdbwd_ms"] = timeit(vjp_of(flash_attention), settle_g)
+        out["dense_fwdbwd_ms"] = timeit(vjp_of(dense_attention), settle_g)
+        out["flash_fwdbwd_speedup"] = (out["dense_fwdbwd_ms"]
+                                       / out["flash_fwdbwd_ms"])
+    except Exception as e:
+        out["fwdbwd_error"] = f"{type(e).__name__}: {e}"
 
     if not fast:
         # STREAMING variant (K/V past the VMEM residency budget): S=32k is

@@ -102,6 +102,19 @@ def main():
         params, turn2, cache, cfg)
     assert int(cache.length) == 16 + 2 + 16
     print(f"multi-turn cache length: {int(cache.length)}")
+
+    # MoE family: the SAME generate() serves a Mixtral-style model —
+    # routing is dropless per decode step, pad rows claim no expert
+    # capacity (models/moe_serve.py)
+    from gpu_provisioner_tpu.models.moe import (PRESETS_MOE,
+                                                init_moe_model)
+    moe_cfg = PRESETS_MOE["tiny-moe"]
+    moe_params = init_moe_model(jax.random.key(3), moe_cfg)
+    moe_prompt = jax.random.randint(jax.random.key(4), (2, 12), 1,
+                                    moe_cfg.vocab_size)
+    moe_out = generate(moe_params, moe_prompt, moe_cfg, max_new_tokens=8,
+                       max_len=64)
+    print("moe    :", moe_out[0].tolist())
     print("done")
 
 

@@ -12,11 +12,15 @@ from .checkpoint import (TrainCheckpointManager, restore_train_state,
 from .decode import (KVCache, generate, init_kv_cache, prefill,
                      prefill_chunked)
 from .llama import LlamaConfig, forward, init_params, param_specs
+from .moe import MoEConfig, init_moe_model, moe_forward
+from .moe_serve import moe_cached_forward, moe_prefill
 from .train import make_train_state, make_train_step
 
 __all__ = [
     "LlamaConfig", "init_params", "forward", "param_specs",
     "make_train_state", "make_train_step",
     "KVCache", "init_kv_cache", "prefill", "prefill_chunked", "generate",
+    "MoEConfig", "init_moe_model", "moe_forward",
+    "moe_cached_forward", "moe_prefill",
     "save_train_state", "restore_train_state", "TrainCheckpointManager",
 ]

@@ -425,11 +425,26 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
         pad_lens = jnp.argmax((prompt != pad_id).astype(jnp.int32),
                               axis=1).astype(jnp.int32)
 
+    # MoE family: same loop, MoE-aware forwards (routing per step is
+    # dropless — moe_serve's module docstring owns the semantics)
+    from .moe import MoEConfig
+    if isinstance(cfg, MoEConfig):
+        from .moe_serve import moe_cached_forward, moe_prefill
+        prefill_fn = lambda p, t, c: moe_prefill(p, t, c, cfg,
+                                                 pad_lens=pad_lens)
+        step_fn = lambda p, t, c: moe_cached_forward(p, t, c, cfg,
+                                                     pad_lens=pad_lens)
+    else:
+        # padded prefill runs the general masked forward (fresh fast path
+        # can't exclude pad keys — see prefill)
+        prefill_fn = lambda p, t, c: prefill(p, t, c, cfg,
+                                             fresh=pad_id is None,
+                                             pad_lens=pad_lens)
+        step_fn = lambda p, t, c: cached_forward(p, t, c, cfg,
+                                                 pad_lens=pad_lens)
+
     cache = init_kv_cache(cfg, B, max_len)
-    # padded prefill runs the general masked forward (fresh fast path
-    # can't exclude pad keys — see prefill)
-    logits, cache = prefill(params, prompt, cache, cfg,
-                            fresh=pad_id is None, pad_lens=pad_lens)
+    logits, cache = prefill_fn(params, prompt, cache)
 
     def pick(logits, key):
         """(token, logprob-under-the-sampling-distribution) per row."""
@@ -458,8 +473,7 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
 
     def step(carry, key_t):
         tok, done, cache = carry
-        new_logits, cache = cached_forward(params, tok[:, None], cache, cfg,
-                                           pad_lens=pad_lens)
+        new_logits, cache = step_fn(params, tok[:, None], cache)
         nxt, lp = pick(new_logits[:, 0], key_t)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)

@@ -80,12 +80,18 @@ def capacity(cfg: MoEConfig, seq_len: int) -> int:
     return max(1, c)
 
 
-def route(logits, k: int, cap: int):
+def route(logits, k: int, cap: int, token_mask=None):
     """Top-k routing → (dispatch [B,S,E,C] one-hot, combine [B,S,E,C]).
 
     Position-in-expert via cumulative sum over the flattened (s, k) choice
     order — deterministic, shape-static, XLA-friendly. Tokens past an
     expert's capacity are dropped.
+
+    ``token_mask`` [B, S] bool: False tokens route NOWHERE — they claim no
+    capacity slot and receive zero FFN output. Serving uses this for
+    left-pad positions, which sit FIRST in the cumsum claim order and
+    would otherwise evict real tokens from full experts (the dense MLP has
+    no such cross-token coupling; capacity does).
     """
     B, S, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [B,S,E]
@@ -94,6 +100,8 @@ def route(logits, k: int, cap: int):
     gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
     onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)       # [B,S,k,E]
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, :, None, None].astype(onehot.dtype)
     # choice order: (s, k) flattened → earlier tokens/choices claim slots first
     flat = onehot.reshape(B, S * k, E)
     pos = jnp.cumsum(flat, axis=1) - flat                          # [B,S*k,E]
@@ -108,13 +116,16 @@ def route(logits, k: int, cap: int):
     return dispatch, combine
 
 
-def moe_ffn(x, lp: dict, cfg: MoEConfig):
-    """One MoE FFN layer. x: [B, S, D] → [B, S, D] (+ aux losses dict)."""
+def moe_ffn(x, lp: dict, cfg: MoEConfig, token_mask=None):
+    """One MoE FFN layer. x: [B, S, D] → [B, S, D] (+ aux losses dict).
+    ``token_mask`` [B, S]: see route() — masked tokens get zero output and
+    claim no expert capacity (serving's left-pad positions)."""
     B, S, D = x.shape
     ad = cfg.act_dtype
     cap = capacity(cfg, S)
     logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
-    dispatch, combine = route(logits, cfg.experts_per_token, cap)
+    dispatch, combine = route(logits, cfg.experts_per_token, cap,
+                              token_mask=token_mask)
 
     # dispatch → [E, B, C, D]: GSPMD turns this into the all-to-all when
     # x is batch-sharded and the expert dim is mesh-sharded

@@ -1,0 +1,136 @@
+"""KV-cache serving for the MoE family — models/decode.py's twin over
+models/moe.py.
+
+The attention half is byte-identical to dense-model serving (same KVCache,
+same head-major layout, same _cached_attention dispatch incl. the flash
+prefill/decode kernels and the int8 cache); only the FFN half differs:
+each layer routes through its experts via moe_ffn.
+
+Routing semantics at serving time, deliberately:
+
+- **prefill** routes exactly like training's moe_forward over the same
+  tokens (capacity computed from the prompt length, earlier tokens claim
+  expert slots first) — prefill logits equal the full forward's logits.
+- **decode steps are dropless**: each step routes its single token with
+  capacity(cfg, 1) ≥ 1 slot per expert, and top-k picks k DISTINCT
+  experts, so a generated token is never capacity-dropped. Teacher-forcing
+  a long sequence through moe_forward CAN drop late tokens that compete
+  for full experts; a served continuation never competes with its prompt.
+  (The standard serving behavior — capacity is a training-efficiency
+  device, not a sampling semantic.)
+
+Aux losses (load-balance, router-z) are computed by moe_ffn and discarded
+here — serving has no optimizer to feed them to.
+
+Reference parity note: the reference provisions nodes for KAITO which
+serves MoE-class models (SURVEY.md §2c); the workload side of this repo
+therefore ships the serving loop for both model families.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import (KVCache, _cached_attention, _quantize_kv, _kv_int8,
+                     init_kv_cache)
+from .llama import _project_qkv, _rmsnorm, resolve_attn as _resolve_attn
+from .moe import MoEConfig, moe_ffn
+
+
+def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
+                       pad_lens=None):
+    """Forward over ``tokens`` [B, S] starting at cache.length; returns
+    (logits [B, S, V], updated cache). The MoE twin of
+    decode.cached_forward — same cache contract (caller guarantees
+    cache.length + S <= max_len), same pad_lens semantics, params in
+    init_moe_model's layout: {"backbone": ..., "moe": per-layer experts}.
+    """
+    _resolve_attn(cfg.attn_impl)
+    ad = cfg.act_dtype
+    B, S = tokens.shape
+    start = cache.length
+    positions = start + jnp.arange(S, dtype=jnp.int32)
+    token_mask = None
+    if pad_lens is not None:
+        # cache position of token i is start+i; row b's pads fill [0, pad_b)
+        token_mask = positions[None, :] >= pad_lens[:, None]       # [B, S]
+        positions = jnp.maximum(positions[None, :] - pad_lens[:, None], 0)
+    scale = cfg.head_dim ** -0.5
+
+    backbone = params["backbone"]
+    x = backbone["embed"].astype(ad)[tokens]
+    int8 = _kv_int8(cfg)
+    if int8 != (cache.k_scale is not None):
+        raise ValueError(
+            f"kv_cache_dtype={cfg.kv_cache_dtype!r} but the cache was "
+            f"built {'WITH' if cache.k_scale is not None else 'without'} "
+            "int8 scales — cfg and init_kv_cache(cfg, ...) must agree")
+
+    def write(buf, new):
+        return lax.dynamic_update_slice(
+            buf, new.transpose(0, 2, 1, 3), (0, 0, start, 0))
+
+    def body(carry, layer):
+        h = carry
+        if int8:
+            lp, lp_moe, k_cache, v_cache, k_scl, v_scl = layer
+        else:
+            lp, lp_moe, k_cache, v_cache = layer
+            k_scl = v_scl = None
+
+        a = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
+        q, k, v = _project_qkv(a, lp, cfg, positions)
+
+        if int8:
+            kq, ks_ = _quantize_kv(k)
+            vq, vs_ = _quantize_kv(v)
+            k_cache, v_cache = write(k_cache, kq), write(v_cache, vq)
+            k_scl, v_scl = write(k_scl, ks_), write(v_scl, vs_)
+        else:
+            k_cache, v_cache = write(k_cache, k), write(v_cache, v)
+
+        o = _cached_attention(q, k_cache, v_cache, start, scale,
+                              impl=cfg.attn_impl, pad_lens=pad_lens,
+                              k_scale=k_scl, v_scale=v_scl)
+        h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
+            @ lp["wo"].astype(ad)
+        m = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
+        # pad positions must not claim expert capacity (they sit FIRST in
+        # the claim order and would evict real tokens) nor emit output
+        ffn_out, _aux = moe_ffn(m, lp_moe, cfg, token_mask=token_mask)
+        h = h + ffn_out
+        out = ((k_cache, v_cache, k_scl, v_scl) if int8
+               else (k_cache, v_cache))
+        return h, out
+
+    xs = ((backbone["blocks"], params["moe"], cache.k, cache.v,
+           cache.k_scale, cache.v_scale) if int8
+          else (backbone["blocks"], params["moe"], cache.k, cache.v))
+    x, caches = lax.scan(body, x, xs)
+    x = _rmsnorm(x, backbone["ln_final"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ backbone["lm_head"].astype(jnp.float32)
+    if int8:
+        k_new, v_new, ks_new, vs_new = caches
+        new_cache = KVCache(k=k_new, v=v_new, length=start + S,
+                            k_scale=ks_new, v_scale=vs_new)
+    else:
+        k_new, v_new = caches
+        new_cache = KVCache(k=k_new, v=v_new, length=start + S)
+    return logits, new_cache
+
+
+def moe_prefill(params: dict, prompt, cache: KVCache, cfg: MoEConfig, *,
+                pad_lens=None):
+    """(last-token logits [B, V], cache) after consuming the prompt.
+    Always the general cached forward — the MoE family has no fresh-cache
+    S×S fast path (the expert dispatch dominates prefill cost, not the
+    attention masking the fast path optimizes away)."""
+    logits, cache = moe_cached_forward(params, prompt, cache, cfg,
+                                       pad_lens=pad_lens)
+    return logits[:, -1], cache
+
+
+__all__ = ["moe_cached_forward", "moe_prefill", "init_kv_cache",
+           "MoEConfig"]

@@ -1,0 +1,124 @@
+"""MoE KV-cache serving (models/moe_serve.py) — the MoE twin of
+tests/test_decode.py. Reference behavior being matched: serving parity for
+every model family the provisioned slices host (SURVEY.md §2c)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpu_provisioner_tpu.models.decode import generate, init_kv_cache
+from gpu_provisioner_tpu.models.moe import (MoEConfig, init_moe_model,
+                                            moe_forward)
+from gpu_provisioner_tpu.models.moe_serve import (moe_cached_forward,
+                                                  moe_prefill)
+
+# f32 + generous capacity: no expert drops anywhere, so the cached path
+# must be EXACTLY the full forward (drops are the one legitimate source of
+# teacher-forcing divergence — see moe_serve docstring)
+CFG = MoEConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                hidden_dim=128, max_seq_len=256, n_experts=4,
+                experts_per_token=2, capacity_factor=8.0, dtype="float32")
+
+
+def _setup(B=2, S0=16, seed=0):
+    params = init_moe_model(jax.random.key(seed), CFG)
+    prompt = jax.random.randint(jax.random.key(seed + 1), (B, S0), 0,
+                                CFG.vocab_size)
+    return params, prompt
+
+
+def test_moe_prefill_matches_full_forward():
+    params, prompt = _setup()
+    full, _aux = moe_forward(params, prompt, CFG)
+    cache = init_kv_cache(CFG, prompt.shape[0], 64)
+    cached, cache2 = moe_cached_forward(params, prompt, cache, CFG)
+    assert int(cache2.length) == prompt.shape[1]
+    np.testing.assert_allclose(np.asarray(cached), np.asarray(full),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_moe_incremental_decode_matches_teacher_forcing():
+    """Feed tokens one at a time through the cache; logits must equal the
+    full forward at every position (capacity high enough that the full
+    forward drops nothing — otherwise divergence is expected and allowed)."""
+    params, prompt = _setup(B=1, S0=12)
+    full, _ = moe_forward(params, prompt, CFG)
+    cache = init_kv_cache(CFG, 1, 32)
+    logits, cache = moe_cached_forward(params, prompt[:, :4], cache, CFG)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :4]),
+                               atol=1e-4, rtol=1e-4)
+    for i in range(4, 12):
+        logits, cache = moe_cached_forward(params, prompt[:, i:i + 1],
+                                           cache, CFG)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, i]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_moe_generate_greedy_and_flash_parity():
+    params, prompt = _setup()
+    toks_d = generate(params, prompt, CFG, max_new_tokens=8, max_len=128)
+    assert toks_d.shape == (2, 8)
+    assert ((toks_d >= 0) & (toks_d < CFG.vocab_size)).all()
+    cfg_f = dataclasses.replace(CFG, attn_impl="flash")
+    toks_f = generate(params, prompt, cfg_f, max_new_tokens=8, max_len=128)
+    assert (toks_d == toks_f).all()
+
+
+def test_moe_generate_sampling_reproducible():
+    params, prompt = _setup()
+    kw = dict(max_new_tokens=8, max_len=128, temperature=0.9, top_k=20,
+              top_p=0.95, key=jax.random.key(3))
+    a = generate(params, prompt, CFG, **kw)
+    b = generate(params, prompt, CFG, **kw)
+    assert (a == b).all()
+    assert ((a >= 0) & (a < CFG.vocab_size)).all()
+
+
+def test_moe_padded_row_matches_solo_generation():
+    """Left-padded ragged batch: pad tokens must not claim expert capacity
+    (token_mask) nor shift RoPE/attention — a padded row generates exactly
+    what it does alone."""
+    params, _ = _setup()
+    PAD = 7
+    p0 = jax.random.randint(jax.random.key(9), (1, 20), 0, CFG.vocab_size)
+    p1 = jax.random.randint(jax.random.key(10), (1, 12), 0, CFG.vocab_size)
+    batch = jnp.concatenate(
+        [p0, jnp.concatenate([jnp.full((1, 8), PAD, jnp.int32), p1], 1)], 0)
+    got = generate(params, batch, CFG, max_new_tokens=6, max_len=64,
+                   pad_id=PAD)
+    solo0 = generate(params, p0, CFG, max_new_tokens=6, max_len=64)
+    solo1 = generate(params, p1, CFG, max_new_tokens=6, max_len=64)
+    assert (got[0] == solo0[0]).all()
+    assert (got[1] == solo1[0]).all()
+
+
+def test_moe_int8_cache_serves():
+    params, prompt = _setup()
+    cfg_q = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    toks_q = generate(params, prompt, cfg_q, max_new_tokens=8, max_len=128)
+    toks_d = generate(params, prompt, CFG, max_new_tokens=8, max_len=128)
+    assert toks_q.shape == (2, 8)
+    # int8 is lossy; require strong top-1 agreement, not equality
+    assert float((toks_q == toks_d).mean()) > 0.7
+
+
+def test_moe_prefill_then_continue_multiturn():
+    """Multi-turn: prefill, decode, prefill again on the same cache —
+    the general cached forward must continue a partially-filled cache."""
+    params, prompt = _setup(B=1, S0=8)
+    cache = init_kv_cache(CFG, 1, 64)
+    logits1, cache = moe_prefill(params, prompt, cache, CFG)
+    assert logits1.shape == (1, CFG.vocab_size)
+    nxt = jnp.argmax(logits1, axis=-1).astype(jnp.int32)[:, None]
+    _, cache = moe_cached_forward(params, nxt, cache, CFG)
+    turn2 = jax.random.randint(jax.random.key(4), (1, 8), 0, CFG.vocab_size)
+    logits2, cache = moe_prefill(params, turn2, cache, CFG)
+    assert int(cache.length) == 8 + 1 + 8
+    # reference: one full forward over the concatenated stream
+    stream = jnp.concatenate([prompt, nxt, turn2], axis=1)
+    full, _ = moe_forward(params, stream, CFG)
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(full[:, -1]),
+                               atol=1e-4, rtol=1e-4)
