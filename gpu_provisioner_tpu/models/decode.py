@@ -125,9 +125,11 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
 
     ``pad_lens`` [B] (left-padded ragged batches — the standard serving
     layout): row b's cache positions [0, pad_lens[b]) hold pad tokens that
-    no query may attend to. S=1 steps mask pads in the decode kernel;
-    padded PREFILL rows stay on the dense path (the prefill kernel masks
-    by position only).
+    no query may attend to. Both kernels mask pads in-kernel (S=1 via the
+    decode kernel's meta, prefill via the cached kernel's) — no serving
+    phase pays the dense sweep for being ragged. Pad-QUERY positions'
+    outputs are unread garbage and DIFFER between impls (kernel: zero;
+    dense: uniform V-average) — consume only real positions.
 
     ``k_scale``/``v_scale`` [B, Hkv, max_len, 1]: int8-cache dequant
     scales. The flash kernel dequantizes IN VMEM (only int8 bytes cross
@@ -141,13 +143,14 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
             return flash_attention_decode(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
                                           v_scale=v_scale, pad_lens=pad_lens)
-    if impl == "flash" and pad_lens is None:
+    if impl == "flash":
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
             return flash_attention_cached(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
-                                          v_scale=v_scale)
+                                          v_scale=v_scale,
+                                          pad_lens=pad_lens)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if k_scale is not None:

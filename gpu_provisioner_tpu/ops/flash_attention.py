@@ -123,21 +123,29 @@ def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
-                         q_pos0, kv_pos0, block_q, block_k, scale, masked):
+                         q_pos0, kv_pos0, block_q, block_k, scale, masked,
+                         kv_min=None):
     """One flash tile: S = qKᵀ·scale (masked below q_pos0+i ≥ kv_pos0+j when
-    ``masked``), then the running-max/denominator update into VMEM scratch.
-    Shared by the streaming self-attention and KV-cache kernels (incl. the
-    int8 variant, which dequantizes before calling) so numerics fixes land
-    in one place. q/k/v are f32 tile VALUES [BQ|BK, D]."""
+    ``masked``; additionally below ``kv_min`` ≤ kv_pos0+j when given — the
+    left-pad lower bound of ragged serving), then the running-max/
+    denominator update into VMEM scratch. Shared by the streaming
+    self-attention and KV-cache kernels (incl. the int8 variant, which
+    dequantizes before calling) so numerics fixes land in one place.
+    q/k/v are f32 tile VALUES [BQ|BK, D]."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [BQ, BK]
-    if masked:
-        q_pos = q_pos0 + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, 1), 0)
+    if masked or kv_min is not None:
         kv_pos = kv_pos0 + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        keep = jnp.ones(s.shape, jnp.bool_)
+        if masked:
+            q_pos = q_pos0 + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            keep = q_pos >= kv_pos
+        if kv_min is not None:
+            keep = keep & (kv_pos >= kv_min)
+        s = jnp.where(keep, s, NEG_INF)
     _online_update(s, v, acc_ref, m_ref, l_ref)
 
 
@@ -211,16 +219,22 @@ def _rows_to_heads(x, B, H):
 
 
 def _causal_kv_index(block_q, block_k, group, causal, *,
-                     prefetch_start=False):
+                     prefetch_start=False, pad_hq=None):
     """kv-side index map for (bh, qi, kj) grids. Under causal masking the
     blocks past the diagonal are clamped to the last live block so the block
     index repeats across the dead tail of the kj loop and the Pallas
     pipeline skips the DMA (a revisited block is not re-fetched).
     ``prefetch_start``: the KV-cache variant, where the diagonal sits at a
-    dynamic offset carried by a scalar-prefetch ref (extra trailing arg)."""
+    dynamic offset carried by a scalar-prefetch ref (extra trailing arg).
+    ``pad_hq``: left-padded ragged batches — the prefetch ref additionally
+    carries per-row pad lengths at [1 + bh // pad_hq], and leading all-pad
+    blocks clamp UP to the first live block (their DMA elides too)."""
     if prefetch_start:
-        def idx(bh, qi, kj, start_ref, g=group):
-            last = (start_ref[0] + qi * block_q + block_q - 1) // block_k
+        def idx(bh, qi, kj, meta_ref, g=group):
+            last = (meta_ref[0] + qi * block_q + block_q - 1) // block_k
+            if pad_hq is not None:
+                first = meta_ref[1 + bh // pad_hq] // block_k
+                return (bh // g, jnp.clip(kj, first, last), 0)
             return (bh // g, jnp.minimum(kj, last), 0)
         return idx
     if not causal:
@@ -398,7 +412,7 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
 # --- KV-cache (serving) forward --------------------------------------------
 
 def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                   scale, int8):
+                   scale, int8, Hq=None, padded=False):
     """Streaming flash where the query block sits at cache positions
     ``start + qi·BQ ..`` against a [max_len]-wide KV cache. ``start`` is a
     traced scalar riding as a scalar-prefetch argument so both the mask and
@@ -410,7 +424,14 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
 
     ``int8``: k/v arrive quantized with per-token scale refs trailing them
     (models/decode.py int8 cache) — tiles dequantize in VMEM, so only the
-    int8 buffers travel over HBM (the bandwidth win is the point)."""
+    int8 buffers travel over HBM (the bandwidth win is the point).
+
+    ``padded``: the prefetch ref is [start, pad_0..pad_B-1]; row b's keys
+    below pad_b are masked and leading all-pad blocks are skipped (their
+    DMA elided by the index-map clamp). Pad-QUERY rows (position < pad_b)
+    end up fully masked and emit ZERO — the dense path emits a uniform
+    V-average there instead; both are unread garbage (only real positions'
+    logits are consumed), but exact-comparison tests must skip pad rows."""
     if int8:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -419,27 +440,29 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
     start = start_ref[0]
+    pad = start_ref[1 + pl.program_id(0) // Hq] if padded else 0
 
     @pl.when(kj == 0)
     def _init():
         _init_softmax_scratch(acc_ref, m_ref, l_ref)
 
     live = kj * block_k <= start + qi * block_q + block_q - 1
+    if padded:
+        live = live & ((kj + 1) * block_k - 1 >= pad)
 
     @pl.when(live)
     def _step():
         if int8:
             k = k_ref[0].astype(jnp.float32) * ks_ref[0]
             v = v_ref[0].astype(jnp.float32) * vs_ref[0]
-            _online_softmax_tile(
-                q_ref[0].astype(jnp.float32), k, v, acc_ref, m_ref, l_ref,
-                q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
-                block_q=block_q, block_k=block_k, scale=scale, masked=True)
         else:
-            _online_softmax_step(
-                q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
-                q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
-                block_q=block_q, block_k=block_k, scale=scale, masked=True)
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+        _online_softmax_tile(
+            q_ref[0].astype(jnp.float32), k, v, acc_ref, m_ref, l_ref,
+            q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, masked=True,
+            kv_min=pad if padded else None)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -449,8 +472,9 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
 def cached_flash_supported(S: int, max_len: int, Hq: int, Hkv: int,
                            block_q: int = None, block_k: int = None) -> bool:
     """True iff flash_attention_cached can take these shapes (S and max_len
-    tile into ≥128-aligned blocks, GQA divides). S=1 decode steps and ragged
-    prompts return False — callers keep the dense masked sweep."""
+    tile into ≥128-aligned blocks, GQA divides). S=1 decode steps return
+    False (they take flash_attention_decode); raggedness does NOT gate the
+    kernel — left-padded batches ride in via pad_lens."""
     bq = _auto_block(S, block_q)
     bk = _auto_block(max_len, block_k)
     return (S % bq == 0 and max_len % bk == 0 and Hq % Hkv == 0
@@ -460,7 +484,7 @@ def cached_flash_supported(S: int, max_len: int, Hq: int, Hkv: int,
 def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
                            block_q: int = None, block_k: int = None,
                            interpret: bool = None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, pad_lens=None):
     """Flash attention of fresh-token queries against a KV cache — the
     serving prefill-continuation path (forward-only, no VJP; decode never
     differentiates). Replaces the dense S×max_len masked sweep of
@@ -480,6 +504,11 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     k_cache/v_cache are int8 and tiles dequantize IN VMEM, so only the
     int8 bytes cross HBM (the quantized cache's bandwidth win carries into
     the kernel instead of falling back to the dense sweep).
+
+    ``pad_lens`` [B] int32: left-padded ragged batches — row b's keys
+    below pad_lens[b] are masked in-kernel and leading all-pad blocks are
+    never DMA'd. Pad-QUERY rows emit zero (see _kernel_cached); only real
+    positions' outputs are meaningful, as in the dense path.
 
     Sharding note: under a tensor-parallel mesh the GSPMD partitioner cannot
     split a pallas_call, so a kv-head-sharded cache is gathered around the
@@ -501,7 +530,11 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     qf = _heads_to_rows(q)                      # O(S) transpose — tiny
     kf = k_cache.reshape(B * Hkv, ML, D)        # head-major: free reshape
     vf = v_cache.reshape(B * Hkv, ML, D)
+    padded = pad_lens is not None
     start_arr = jnp.asarray(start, jnp.int32).reshape(1)
+    if padded:
+        start_arr = jnp.concatenate([start_arr,
+                                     pad_lens.astype(jnp.int32)])
 
     def q_idx(bh, qi, kj, start_ref):
         return (bh, qi, 0)
@@ -509,7 +542,8 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     # clamp to the dynamic causal frontier: dead blocks repeat the last
     # live index, so the pipeline elides their DMA
     kv_idx = _causal_kv_index(block_q, block_k, group, True,
-                              prefetch_start=True)
+                              prefetch_start=True,
+                              pad_hq=Hq if padded else None)
 
     int8 = k_scale is not None
     in_specs = [
@@ -539,7 +573,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     )
     out = pl.pallas_call(
         functools.partial(_kernel_cached, block_q=block_q, block_k=block_k,
-                          scale=scale, int8=int8),
+                          scale=scale, int8=int8, Hq=Hq, padded=padded),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
         interpret=interpret,

@@ -149,6 +149,15 @@ def run_cached_checks():
           _cached_attention(q, hm(kq), hm(vq), s, scale,
                             k_scale=hm(kscl), v_scale=hm(vscl)), TOL_F32)
 
+    # padded prefill (ragged serving): real query rows only — pad-query
+    # rows are unread garbage that differs between impls by design
+    pad = jnp.asarray([0, 37], jnp.int32)
+    s = jnp.asarray(256, jnp.int32)
+    outp = fa.flash_attention_cached(q, kc, vc, s, scale=scale,
+                                     pad_lens=pad)
+    refp = _cached_attention(q, kc, vc, s, scale, pad_lens=pad)
+    check("cached_fwd_padded", outp, refp, TOL_F32)   # all rows real @256
+
     # decode-step kernel (S=1, per-kv-head grid, O(start) DMA)
     q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
     for start in (0, 130, 384):

@@ -295,6 +295,86 @@ def test_decode_flash_under_jit_traced_start():
                                    np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_cached_flash_padded_matches_dense_on_real_rows():
+    """pad_lens in the PREFILL kernel: key positions below each row's pad
+    length are masked and leading all-pad blocks un-fetched. Pad-QUERY
+    rows are unread garbage that legitimately differs between impls
+    (kernel: zero; dense: uniform V-average) — compare real rows only."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 3, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(16), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    pad = jnp.asarray([0, 17, 300], jnp.int32)
+    scale = D ** -0.5
+    for start in (0, 320):
+        s = jnp.asarray(start, jnp.int32)
+        out = flash_attention_cached(q, kc, vc, s, scale=scale,
+                                     pad_lens=pad)
+        ref = _cached_attention(q, kc, vc, s, scale, pad_lens=pad)
+        for b in range(B):
+            # query position of row i is start+i; real iff >= pad[b]
+            real = np.asarray(s + jnp.arange(S) >= pad[b])
+            np.testing.assert_allclose(np.asarray(out[b])[real],
+                                       np.asarray(ref[b])[real],
+                                       atol=2e-5, rtol=2e-5)
+
+
+def test_cached_flash_padded_int8_matches_dense_on_real_rows():
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(17), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    pad = jnp.asarray([5, 140], jnp.int32)
+    s = jnp.asarray(256, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_cached(q, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl),
+                                 pad_lens=pad)
+    ref = _cached_attention(q, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl),
+                            pad_lens=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_generate_ragged_flash_prefill_matches_solo():
+    """Integration: a left-padded ragged batch under attn_impl='flash' with
+    a BLOCK-SIZED prompt (so the padded prefill takes the kernel) generates
+    exactly what each row generates alone."""
+    import dataclasses
+    from gpu_provisioner_tpu.models.decode import generate
+    from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                      dtype="float32", attn_impl="flash")
+    params = init_params(jax.random.key(20), cfg)
+    PAD = 3
+    p0 = jax.random.randint(jax.random.key(21), (1, 128), 4, 128)
+    p1 = jax.random.randint(jax.random.key(22), (1, 96), 4, 128)
+    batch = jnp.concatenate(
+        [p0, jnp.concatenate([jnp.full((1, 32), PAD, jnp.int32), p1], 1)], 0)
+    got = generate(params, batch, cfg, max_new_tokens=4, max_len=256,
+                   pad_id=PAD)
+    cfg_d = dataclasses.replace(cfg, attn_impl="dense")
+    solo0 = generate(params, p0, cfg_d, max_new_tokens=4, max_len=256)
+    solo1 = generate(params, p1, cfg_d, max_new_tokens=4, max_len=256)
+    assert (got[0] == solo0[0]).all()
+    assert (got[1] == solo1[0]).all()
+
+
 def test_cached_flash_supported_gates():
     from gpu_provisioner_tpu.ops.flash_attention import cached_flash_supported
     assert cached_flash_supported(128, 512, 4, 2)
