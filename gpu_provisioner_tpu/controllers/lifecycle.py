@@ -133,12 +133,14 @@ class NodeClaimLifecycleController:
         return Result(requeue_after=min(requeues)) if requeues else Result()
 
     async def _flush_status(self, nc: NodeClaim) -> None:
-        from ..runtime.store import to_comparable
-
         def copy_status(obj):
             # No-op writes would bump resourceVersion → watch event → another
             # reconcile: a self-sustaining hot loop on steady-state claims.
-            if to_comparable(obj.status) == to_comparable(nc.status):
+            # Dataclass == (recursive, allocation-free) — both statuses are
+            # same-class in-memory trees; serializing them to dicts first
+            # was the top steady-state CPU cost at 1024 claims (~20% of
+            # busy time profiled).
+            if obj.status == nc.status:
                 return False
             obj.status = nc.status
 
