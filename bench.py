@@ -380,6 +380,47 @@ def bench_decode(fast: bool) -> dict:
     return out
 
 
+def bench_moe_decode(fast: bool) -> dict:
+    """MoE-family serving throughput (models/moe_serve.py): greedy batch
+    decode on a Mixtral-style config — top-2 of 8 experts, so ~2/8 of the
+    FFN weights activate per token while all experts' weights sit in HBM
+    (the serving economics MoE buys)."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_provisioner_tpu.models.decode import generate
+    from gpu_provisioner_tpu.models.moe import MoEConfig, init_moe_model
+
+    dev = jax.devices()[0]
+    cfg = (MoEConfig(vocab_size=2048, dim=256, n_layers=2, n_heads=8,
+                     n_kv_heads=4, hidden_dim=512, n_experts=4,
+                     experts_per_token=2, dtype="bfloat16",
+                     attn_impl="flash")
+           if fast else
+           MoEConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+                     n_kv_heads=8, hidden_dim=2816, n_experts=8,
+                     experts_per_token=2, dtype="bfloat16",
+                     attn_impl="flash"))
+    B, S0, NEW = (2, 128, 16) if fast else (8, 512, 128)
+    params = jax.device_put(init_moe_model(jax.random.key(0), cfg), dev)
+    prompt = jax.device_put(jnp.zeros((B, S0), jnp.int32), dev)
+    gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=NEW))
+
+    def settle(x):
+        x.block_until_ready()
+        return int(x[0, 0])
+
+    settle(gen(params, prompt))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = gen(params, prompt)
+        settle(out)
+        best = min(best, time.perf_counter() - t0)
+    return {"batch": B, "prompt_len": S0, "new_tokens": NEW,
+            "n_experts": cfg.n_experts, "total_ms": best * 1e3,
+            "decode_tokens_per_s": B * NEW / best}
+
+
 def bench_flash_op(fast: bool) -> dict:
     """Pallas flash-attention kernel vs the dense lax path, one op."""
     import jax
@@ -564,6 +605,10 @@ def main(argv=None) -> int:
             extra["prefill_cached"] = rounded(bench_cached_prefill(args.fast))
         except Exception as e:
             extra["prefill_cached_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra["moe_decode"] = rounded(bench_moe_decode(args.fast))
+        except Exception as e:
+            extra["moe_decode_error"] = f"{type(e).__name__}: {e}"
         try:
             extra["train"] = rounded(bench_train_step(args.fast), 4)
             extra["long_context"] = rounded(bench_long_context(args.fast))

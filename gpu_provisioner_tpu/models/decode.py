@@ -105,7 +105,8 @@ def _quantize_kv(x):
 
 
 def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
-                      pad_lens=None, k_scale=None, v_scale=None):
+                      pad_lens=None, k_scale=None, v_scale=None,
+                      window=None):
     """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
     a key at position p is attendable iff p <= start + query_idx (causal,
     and positions beyond the written prefix are masked by the same bound).
@@ -133,7 +134,11 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
 
     ``k_scale``/``v_scale`` [B, Hkv, max_len, 1]: int8-cache dequant
     scales. The flash kernel dequantizes IN VMEM (only int8 bytes cross
-    HBM); the dense path dequantizes in the read einsum."""
+    HBM); the dense path dequantizes in the read einsum.
+
+    ``window`` (cfg.sliding_window): query p attends keys in
+    (p − window, p] — both kernels bound their DMA to the window, so SWA
+    serving cost is O(window) per step regardless of cached history."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
     if impl == "flash" and S == 1:
@@ -142,7 +147,8 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
         if decode_flash_supported(max_len, Hq, Hkv):
             return flash_attention_decode(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
-                                          v_scale=v_scale, pad_lens=pad_lens)
+                                          v_scale=v_scale, pad_lens=pad_lens,
+                                          window=window)
     if impl == "flash":
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
@@ -150,7 +156,7 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
             return flash_attention_cached(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
                                           v_scale=v_scale,
-                                          pad_lens=pad_lens)
+                                          pad_lens=pad_lens, window=window)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if k_scale is not None:
@@ -163,6 +169,8 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     key_pos = jnp.arange(max_len)                      # [K]
     q_pos = start + jnp.arange(S)                      # [S]
     mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
+    if window is not None:
+        mask = mask & (key_pos[None, :] > q_pos[:, None] - window)
     if pad_lens is None:
         s = jnp.where(mask[None, None, None], s, NEG_INF)
     else:
@@ -188,7 +196,7 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
     index is traced, so this cannot be checked here; past the bound,
     ``dynamic_update_slice`` clamps and silently corrupts the cache.
     ``generate`` enforces it; manual decode loops must too."""
-    _resolve_attn(cfg.attn_impl)  # validate loudly — the dense fallback in
+    _resolve_attn(cfg.attn_impl, cfg.sliding_window)  # validate loudly — the dense fallback in
     # _cached_attention is shape-driven, not a typo escape hatch
     ad = cfg.act_dtype
     B, S = tokens.shape
@@ -235,7 +243,8 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
 
         o = _cached_attention(q, k_cache, v_cache, start, scale,
                               impl=cfg.attn_impl, pad_lens=pad_lens,
-                              k_scale=k_scl, v_scale=v_scl)
+                              k_scale=k_scl, v_scale=v_scl,
+                              window=cfg.sliding_window)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         h = _mlp_half(h, lp, cfg)
@@ -263,6 +272,9 @@ def _prefill_forward(params: dict, tokens, max_len: int, cfg: LlamaConfig):
     attention is plain causal attention over the prompt window — S×S scores
     (flash-kernel eligible via cfg.attn_impl) instead of cached_forward's
     S×max_len masked sweep, and the cache is written once at offset 0."""
+    assert cfg.sliding_window is None, \
+        "fresh fast path has no window mask — prefill() routes SWA configs " \
+        "to the general cached forward"
     ad = cfg.act_dtype
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)
@@ -312,7 +324,11 @@ def prefill(params: dict, prompt, cache: KVCache, cfg: LlamaConfig, *,
     the S×S fast path; otherwise the general cached forward runs, correct
     for continuing a partially-filled cache. ``pad_lens`` [B] serves a
     left-padded ragged batch (see cached_forward) — incompatible with the
-    fresh fast path, whose plain causal attention can't exclude pad keys."""
+    fresh fast path, whose plain causal attention can't exclude pad keys.
+    ``cfg.sliding_window`` likewise routes to the general path, whose
+    kernels window-mask AND bound their DMA to the window."""
+    if cfg.sliding_window is not None:
+        fresh = False
     if fresh:
         if pad_lens is not None:
             raise ValueError("pad_lens requires fresh=False — the fresh "

@@ -52,6 +52,12 @@ class LlamaConfig:
     kv_cache_dtype: str = "auto"   # "auto" (= act dtype) | "int8" (quantized
                                    # serving cache: half the HBM, on-the-fly
                                    # dequant — models/decode.py)
+    sliding_window: Optional[int] = None
+                                   # Mistral-style sliding-window attention:
+                                   # query p attends (p-window, p]. Serving
+                                   # takes the windowed Pallas kernels
+                                   # (O(window) cache DMA); the full forward
+                                   # masks densely. None = full causal.
 
     @property
     def head_dim(self) -> int:
@@ -71,16 +77,35 @@ PRESETS = {
 }
 
 
-def resolve_attn(impl: str) -> Callable:
+def resolve_attn(impl: str, window: Optional[int] = None) -> Callable:
     """cfg.attn_impl → attention callable (the one dispatch point — forward,
     the pipelined stage body, and serving prefill all resolve through here).
-    Unknown values raise instead of silently running dense."""
+    Unknown values raise instead of silently running dense.
+
+    ``window`` (cfg.sliding_window): the SELF-attention path masks densely
+    — windowed Pallas kernels exist on the KV-cache serving path
+    (ops/flash_attention.py:flash_attention_cached/_decode), where the
+    O(window) DMA bound pays; a windowed self-attention kernel would also
+    need a windowed backward, which nothing needs yet. Correctness first:
+    with a window set, impl="flash" deliberately resolves to the masked
+    dense path rather than silently dropping the window."""
+    if impl not in ("flash", "dense"):
+        raise ValueError(
+            f"unknown attn_impl {impl!r}; expected 'dense'|'flash'")
+    if window is not None:
+        if window <= 0:
+            # window=0 would all-NEG_INF every score row and the impls
+            # would silently disagree on the garbage (dense: uniform
+            # V-average; kernels: zeros) — same loud-validation rule as
+            # the impl check above
+            raise ValueError(
+                f"sliding_window must be positive, got {window} "
+                "(use None to disable)")
+        return partial(dense_attention, window=window)
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention
-    if impl == "dense":
-        return dense_attention
-    raise ValueError(f"unknown attn_impl {impl!r}; expected 'dense'|'flash'")
+    return dense_attention
 
 
 def init_params(key, cfg: LlamaConfig) -> dict:
@@ -202,7 +227,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     sequence axis is sharded.
     """
     if attn_fn is None:
-        attn_fn = resolve_attn(cfg.attn_impl)
+        attn_fn = resolve_attn(cfg.attn_impl, cfg.sliding_window)
     ad = cfg.act_dtype
     B, S = tokens.shape
     if positions is None:

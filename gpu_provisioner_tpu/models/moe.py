@@ -180,11 +180,10 @@ def moe_model_specs(cfg: MoEConfig) -> dict:
 
 def moe_forward(params: dict, tokens, cfg: MoEConfig, attn_fn=None):
     """Logits + mean aux losses. tokens: [B, S] → ([B, S, V], aux dict)."""
-    from .llama import _rope  # noqa: F401  (rope applied inside the block)
-    from ..parallel.ring import dense_attention
+    from .llama import _rope, resolve_attn  # noqa: F401  (rope in the block)
 
     if attn_fn is None:
-        attn_fn = dense_attention
+        attn_fn = resolve_attn("dense", cfg.sliding_window)
     ad = cfg.act_dtype
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)
@@ -228,7 +227,8 @@ def make_moe_train_step(mesh, cfg: MoEConfig, optimizer=None):
     if optimizer is None:
         optimizer = default_optimizer()
     attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
-                           seq_schedule=cfg.seq_schedule)
+                           seq_schedule=cfg.seq_schedule,
+                           window=cfg.sliding_window)
 
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(moe_loss_fn)(

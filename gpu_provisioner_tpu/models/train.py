@@ -36,17 +36,26 @@ def default_optimizer(mu_dtype=None):
 
 
 def make_attn_fn(mesh, impl: str = "dense",
-                 seq_schedule: str = "ring") -> Callable:
+                 seq_schedule: str = "ring",
+                 window: int = None) -> Callable:
     """Attention for the mesh: ring over ``seq`` when that axis is sharded;
     otherwise the pallas flash kernel (impl="flash") or dense, shard_mapped
     so each device runs the kernel on its local (batch, head) shard.
     ``seq_schedule="zigzag"`` load-balances the causal ring (every shard
     holds an early+late chunk pair; see parallel/ring.py) at the cost of a
     seq permutation outside the shard_map — GSPMD lowers the gathers to
-    all-to-alls on ICI, negligible next to the O(S²/n) attention saved."""
-    attn = resolve_attn(impl)   # validates impl for every branch below
+    all-to-alls on ICI, negligible next to the O(S²/n) attention saved.
+
+    ``window`` (cfg.sliding_window): resolves to the densely-masked window
+    path (resolve_attn); composing SWA with a seq-sharded ring schedule is
+    not implemented — raise rather than silently train full-causal."""
+    attn = resolve_attn(impl, window)  # validates impl for every branch below
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:
+        if window is not None:
+            raise NotImplementedError(
+                "sliding_window × sequence-parallel ring attention is not "
+                "implemented; train SWA models with sp=1")
         if seq_schedule == "zigzag":
             from ..parallel.ring import zigzag_order, zigzag_ring_attention
 
@@ -122,6 +131,10 @@ def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
         optimizer = default_optimizer()
     zigzag = (cfg.seq_schedule == "zigzag" and mesh.shape[AXIS_SEQ] > 1)
     if zigzag:
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "sliding_window × sequence-parallel ring attention is not "
+                "implemented; train SWA models with sp=1")
         from ..parallel.ring import zigzag_order, zigzag_ring_attention
 
         qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
@@ -132,7 +145,8 @@ def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
             out_specs=qkv_spec, check_vma=False)
     else:
         attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
-                               seq_schedule=cfg.seq_schedule)
+                               seq_schedule=cfg.seq_schedule,
+                               window=cfg.sliding_window)
 
     def step(params, opt_state, inputs, targets):
         positions = None
@@ -184,7 +198,7 @@ def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
     if optimizer is None:
         optimizer = default_optimizer()
     state_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
-    stage_attn = resolve_attn(cfg.attn_impl)
+    stage_attn = resolve_attn(cfg.attn_impl, cfg.sliding_window)
 
     def pipelined_forward(params, tokens):
         ad = cfg.act_dtype

@@ -124,10 +124,11 @@ def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
 
 def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
                          q_pos0, kv_pos0, block_q, block_k, scale, masked,
-                         kv_min=None):
+                         kv_min=None, window=None):
     """One flash tile: S = qKᵀ·scale (masked below q_pos0+i ≥ kv_pos0+j when
     ``masked``; additionally below ``kv_min`` ≤ kv_pos0+j when given — the
-    left-pad lower bound of ragged serving), then the running-max/
+    left-pad lower bound of ragged serving — and within the sliding
+    ``window`` when given: kv_pos > q_pos − window), then the running-max/
     denominator update into VMEM scratch. Shared by the streaming
     self-attention and KV-cache kernels (incl. the int8 variant, which
     dequantizes before calling) so numerics fixes land in one place.
@@ -135,16 +136,18 @@ def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [BQ, BK]
-    if masked or kv_min is not None:
+    if masked or kv_min is not None or window is not None:
         kv_pos = kv_pos0 + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
         keep = jnp.ones(s.shape, jnp.bool_)
         if masked:
-            q_pos = q_pos0 + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
             keep = q_pos >= kv_pos
         if kv_min is not None:
             keep = keep & (kv_pos >= kv_min)
+        if window is not None:
+            keep = keep & (kv_pos > q_pos - window)
         s = jnp.where(keep, s, NEG_INF)
     _online_update(s, v, acc_ref, m_ref, l_ref)
 
@@ -219,7 +222,7 @@ def _rows_to_heads(x, B, H):
 
 
 def _causal_kv_index(block_q, block_k, group, causal, *,
-                     prefetch_start=False, pad_hq=None):
+                     prefetch_start=False, pad_hq=None, window=None):
     """kv-side index map for (bh, qi, kj) grids. Under causal masking the
     blocks past the diagonal are clamped to the last live block so the block
     index repeats across the dead tail of the kj loop and the Pallas
@@ -228,13 +231,21 @@ def _causal_kv_index(block_q, block_k, group, causal, *,
     dynamic offset carried by a scalar-prefetch ref (extra trailing arg).
     ``pad_hq``: left-padded ragged batches — the prefetch ref additionally
     carries per-row pad lengths at [1 + bh // pad_hq], and leading all-pad
-    blocks clamp UP to the first live block (their DMA elides too)."""
+    blocks clamp UP to the first live block (their DMA elides too).
+    ``window``: sliding-window attention — blocks entirely below the
+    window's lower edge likewise clamp up and never fetch."""
     if prefetch_start:
         def idx(bh, qi, kj, meta_ref, g=group):
             last = (meta_ref[0] + qi * block_q + block_q - 1) // block_k
+            lo_pos = None
             if pad_hq is not None:
-                first = meta_ref[1 + bh // pad_hq] // block_k
-                return (bh // g, jnp.clip(kj, first, last), 0)
+                lo_pos = meta_ref[1 + bh // pad_hq]
+            if window is not None:
+                wlo = jnp.maximum(
+                    meta_ref[0] + qi * block_q - window + 1, 0)
+                lo_pos = wlo if lo_pos is None else jnp.maximum(lo_pos, wlo)
+            if lo_pos is not None:
+                return (bh // g, jnp.clip(kj, lo_pos // block_k, last), 0)
             return (bh // g, jnp.minimum(kj, last), 0)
         return idx
     if not causal:
@@ -412,7 +423,7 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
 # --- KV-cache (serving) forward --------------------------------------------
 
 def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                   scale, int8, Hq=None, padded=False):
+                   scale, int8, Hq=None, padded=False, window=None):
     """Streaming flash where the query block sits at cache positions
     ``start + qi·BQ ..`` against a [max_len]-wide KV cache. ``start`` is a
     traced scalar riding as a scalar-prefetch argument so both the mask and
@@ -449,6 +460,11 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
     live = kj * block_k <= start + qi * block_q + block_q - 1
     if padded:
         live = live & ((kj + 1) * block_k - 1 >= pad)
+    if window is not None:
+        # the union of row windows is (qmin − window, qmax]; a kv block is
+        # dead when it sits entirely at/below the earliest row's lower edge
+        live = live & ((kj + 1) * block_k - 1
+                       >= start + qi * block_q - window + 1)
 
     @pl.when(live)
     def _step():
@@ -462,7 +478,7 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
             q_ref[0].astype(jnp.float32), k, v, acc_ref, m_ref, l_ref,
             q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
             block_q=block_q, block_k=block_k, scale=scale, masked=True,
-            kv_min=pad if padded else None)
+            kv_min=pad if padded else None, window=window)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -484,7 +500,8 @@ def cached_flash_supported(S: int, max_len: int, Hq: int, Hkv: int,
 def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
                            block_q: int = None, block_k: int = None,
                            interpret: bool = None,
-                           k_scale=None, v_scale=None, pad_lens=None):
+                           k_scale=None, v_scale=None, pad_lens=None,
+                           window: int = None):
     """Flash attention of fresh-token queries against a KV cache — the
     serving prefill-continuation path (forward-only, no VJP; decode never
     differentiates). Replaces the dense S×max_len masked sweep of
@@ -509,6 +526,11 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     below pad_lens[b] are masked in-kernel and leading all-pad blocks are
     never DMA'd. Pad-QUERY rows emit zero (see _kernel_cached); only real
     positions' outputs are meaningful, as in the dense path.
+
+    ``window``: sliding-window attention (Mistral-style) — a query at
+    position p attends keys in (p − window, p]. Blocks entirely below a
+    q-block's window clamp out of the index map, so long-context SWA
+    prefill fetches O(window) of the cache per q-block, not O(start).
 
     Sharding note: under a tensor-parallel mesh the GSPMD partitioner cannot
     split a pallas_call, so a kv-head-sharded cache is gathered around the
@@ -543,7 +565,8 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     # live index, so the pipeline elides their DMA
     kv_idx = _causal_kv_index(block_q, block_k, group, True,
                               prefetch_start=True,
-                              pad_hq=Hq if padded else None)
+                              pad_hq=Hq if padded else None,
+                              window=window)
 
     int8 = k_scale is not None
     in_specs = [
@@ -573,7 +596,8 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     )
     out = pl.pallas_call(
         functools.partial(_kernel_cached, block_q=block_q, block_k=block_k,
-                          scale=scale, int8=int8, Hq=Hq, padded=padded),
+                          scale=scale, int8=int8, Hq=Hq, padded=padded,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
         interpret=interpret,
@@ -584,7 +608,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
 # --- KV-cache decode step (S = 1) ------------------------------------------
 
 def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
-                   scale, int8, padded):
+                   scale, int8, padded, window=None):
     """One generated token's attention against the cache: grid row bh owns
     kv head ``bh % Hkv`` of batch ``bh // Hkv`` and computes ALL ``group``
     of its GQA queries in one pass — the cache tile is fetched once per kv
@@ -609,6 +633,8 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
     live = kj * block_k <= start
     if padded:
         live = live & ((kj + 1) * block_k - 1 >= pad)
+    if window is not None:
+        live = live & ((kj + 1) * block_k - 1 >= start - window + 1)
 
     @pl.when(live)
     def _step():
@@ -627,6 +653,8 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
         mask = kv_pos <= start
         if padded:
             mask = mask & (kv_pos >= pad)
+        if window is not None:
+            mask = mask & (kv_pos > start - window)
         _online_update(jnp.where(mask, s, NEG_INF), v, acc_ref, m_ref, l_ref)
 
     @pl.when(kj == n_kv - 1)
@@ -644,7 +672,8 @@ def decode_flash_supported(max_len: int, Hq: int, Hkv: int,
 
 def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
                            block_k: int = None, interpret: bool = None,
-                           k_scale=None, v_scale=None, pad_lens=None):
+                           k_scale=None, v_scale=None, pad_lens=None,
+                           window: int = None):
     """The serving decode step as a Pallas kernel: ONE new token per row
     ([B, 1, Hq, D] queries at cache position ``start``) against a
     [B, Hkv, max_len, D] head-major cache (forward-only; decode never
@@ -663,8 +692,10 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
     ``k_scale``/``v_scale``: int8-cache mode, dequantized in VMEM as in
     flash_attention_cached. ``pad_lens`` [B] int32: left-padded ragged
     batches — row b may only attend to positions ≥ pad_lens[b]; leading
-    all-pad blocks are likewise skipped and un-fetched. Callers gate on
-    decode_flash_supported()."""
+    all-pad blocks are likewise skipped and un-fetched. ``window``:
+    sliding-window attention — keys in (start − window, start]; a
+    long-context SWA decode step fetches O(window), independent of how
+    much history is cached. Callers gate on decode_flash_supported()."""
     B, S, Hq, D = q.shape
     assert S == 1, f"decode kernel is single-token; got S={S}"
     Hkv, ML = k_cache.shape[1], k_cache.shape[2]
@@ -686,9 +717,12 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
         meta = jnp.concatenate([meta, pad_lens.astype(jnp.int32)])
 
     def kv_idx(bh, kj, meta_ref):
-        lo = meta_ref[1 + bh // Hkv] // block_k if padded else 0
+        lo_pos = meta_ref[1 + bh // Hkv] if padded else 0
+        if window is not None:
+            lo_pos = jnp.maximum(lo_pos,
+                                 jnp.maximum(meta_ref[0] - window + 1, 0))
         hi = meta_ref[0] // block_k
-        return (bh, jnp.clip(kj, lo, hi), 0)
+        return (bh, jnp.clip(kj, lo_pos // block_k, hi), 0)
 
     q_idx = lambda bh, kj, meta_ref: (bh, 0, 0)
     in_specs = [
@@ -720,7 +754,7 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
     out = pl.pallas_call(
         functools.partial(_kernel_decode, Hkv=Hkv, group=group,
                           block_k=block_k, scale=scale, int8=int8,
-                          padded=padded),
+                          padded=padded, window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hkv, group, D), q.dtype),
         interpret=interpret,

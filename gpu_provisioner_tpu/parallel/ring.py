@@ -165,12 +165,17 @@ def _ring_flash(q, k, v, *, axis_name, causal, scale, n, my):
 
 
 def dense_attention_with_lse(q, k, v, *, causal: bool = True,
-                             scale: float | None = None):
+                             scale: float | None = None,
+                             window: int | None = None):
     """Single-device exact attention returning (out, lse [B,Hq,Sq]) — the
     canonical dense implementation; the lse output is the merge handle the
     flash-ring path needs, and XLA dead-code-eliminates it for callers that
     drop it. Fully-masked rows yield zeros (not uniform-softmax garbage)
-    and lse = NEG_INF, matching the Pallas kernel's convention."""
+    and lse = NEG_INF, matching the Pallas kernel's convention.
+
+    ``window``: sliding-window attention (Mistral-style) — query i attends
+    keys in (i - window, i]; composes with ``causal`` (which SWA models
+    always set)."""
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
@@ -181,9 +186,15 @@ def dense_attention_with_lse(q, k, v, *, causal: bool = True,
         v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
+    if causal or window is not None:
         Sq, Sk = q.shape[1], k.shape[1]
-        mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+        q_pos = jnp.arange(Sq)[:, None]
+        k_pos = jnp.arange(Sk)[None, :]
+        mask = jnp.ones((Sq, Sk), jnp.bool_)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -197,10 +208,11 @@ def dense_attention_with_lse(q, k, v, *, causal: bool = True,
 
 
 def dense_attention(q, k, v, *, causal: bool = True,
-                    scale: float | None = None):
+                    scale: float | None = None, window: int | None = None):
     """Single-device exact attention (same contract, no mesh axis) — the
     n=1 specialization used by entry()'s single-chip forward."""
-    return dense_attention_with_lse(q, k, v, causal=causal, scale=scale)[0]
+    return dense_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    window=window)[0]
 
 
 # --- zigzag ring: balanced causal schedule ---------------------------------

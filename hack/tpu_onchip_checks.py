@@ -158,6 +158,12 @@ def run_cached_checks():
     refp = _cached_attention(q, kc, vc, s, scale, pad_lens=pad)
     check("cached_fwd_padded", outp, refp, TOL_F32)   # all rows real @256
 
+    # sliding-window serving (window masks + lower-bound DMA clamps)
+    s = jnp.asarray(320, jnp.int32)
+    check("cached_fwd_window",
+          fa.flash_attention_cached(q, kc, vc, s, scale=scale, window=100),
+          _cached_attention(q, kc, vc, s, scale, window=100), TOL_F32)
+
     # decode-step kernel (S=1, per-kv-head grid, O(start) DMA)
     q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
     for start in (0, 130, 384):
@@ -176,6 +182,9 @@ def run_cached_checks():
                                     k_scale=hm(kscl), v_scale=hm(vscl)),
           _cached_attention(q1, hm(kq), hm(vq), s, scale,
                             k_scale=hm(kscl), v_scale=hm(vscl)), TOL_F32)
+    check("decode_fwd_window",
+          fa.flash_attention_decode(q1, kc, vc, s, scale=scale, window=100),
+          _cached_attention(q1, kc, vc, s, scale, window=100), TOL_F32)
 
 
 def run_generate_check():

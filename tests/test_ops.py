@@ -375,6 +375,114 @@ def test_generate_ragged_flash_prefill_matches_solo():
     assert (got[1] == solo1[0]).all()
 
 
+@pytest.mark.parametrize("window", [64, 200, 1000])
+def test_cached_flash_windowed_matches_dense(window):
+    """Sliding-window prefill kernel vs the dense windowed sweep — incl. a
+    window larger than the live prefix (degenerates to plain causal)."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(18), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    for start in (0, 320):
+        s = jnp.asarray(start, jnp.int32)
+        out = flash_attention_cached(q, kc, vc, s, scale=scale,
+                                     window=window)
+        ref = _cached_attention(q, kc, vc, s, scale, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_cached_flash_windowed_padded_matches_dense():
+    """window × pad_lens: both lower bounds compose (max of the two)."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(19), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    pad = jnp.asarray([5, 250], jnp.int32)
+    s = jnp.asarray(256, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_cached(q, kc, vc, s, scale=scale, pad_lens=pad,
+                                 window=100)
+    ref = _cached_attention(q, kc, vc, s, scale, pad_lens=pad, window=100)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [64, 1000])
+def test_decode_flash_windowed_matches_dense(window):
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 2, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(20), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    for start in (0, 130, 400):
+        s = jnp.asarray(start, jnp.int32)
+        out = flash_attention_decode(q, kc, vc, s, scale=scale,
+                                     window=window)
+        ref = _cached_attention(q, kc, vc, s, scale, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_windowed_padded_matches_dense():
+    """window × pad_lens in the DECODE kernel: the lower bound is the max
+    of the pad edge and the window edge, in both the mask and the DMA
+    clamp (the standard left-padded SWA serving layout)."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 3, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(23), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    # pad edge below, inside, and above the window's lower edge
+    pad = jnp.asarray([0, 250, 400], jnp.int32)
+    s = jnp.asarray(420, jnp.int32)
+    scale = D ** -0.5
+    out = flash_attention_decode(q, kc, vc, s, scale=scale, pad_lens=pad,
+                                 window=128)
+    ref = _cached_attention(q, kc, vc, s, scale, pad_lens=pad, window=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_validation():
+    from gpu_provisioner_tpu.models.llama import resolve_attn
+    with pytest.raises(ValueError, match="sliding_window must be positive"):
+        resolve_attn("dense", 0)
+    with pytest.raises(ValueError, match="sliding_window must be positive"):
+        resolve_attn("flash", -4)
+
+
+def test_dense_attention_window_mask():
+    """dense_attention(window=...) against a brute-force masked softmax."""
+    q, k, v = _qkv(B=1, S=64, Hq=2, Hkv=2, D=16)
+    W = 16
+    out = dense_attention(q, k, v, causal=True, window=W)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (16 ** -0.5)
+    qp = jnp.arange(64)[:, None]
+    kp = jnp.arange(64)[None, :]
+    mask = (qp >= kp) & (kp > qp - W)
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_cached_flash_supported_gates():
     from gpu_provisioner_tpu.ops.flash_attention import cached_flash_supported
     assert cached_flash_supported(128, 512, 4, 2)
