@@ -380,6 +380,45 @@ def bench_decode(fast: bool) -> dict:
     return out
 
 
+def bench_speculative(fast: bool) -> dict:
+    """Speculative decoding round-trip cost with a SELF-draft (draft ==
+    target ⇒ every proposal accepted): the measured tokens/s is the
+    acceptance UPPER BOUND — real deployments sit between this and plain
+    decode depending on draft quality. What this times on silicon: the
+    k-step draft scan, the wide verify call, and the rollback plumbing."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+    from gpu_provisioner_tpu.models.speculative import speculative_generate
+
+    dev = jax.devices()[0]
+    cfg = (LlamaConfig(vocab_size=2048, dim=512, n_layers=4, n_heads=8,
+                       n_kv_heads=4, hidden_dim=1408, dtype="bfloat16")
+           if fast else
+           LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16"))
+    S0, NEW, K = (64, 16, 3) if fast else (256, 96, 4)
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    prompt = jax.device_put(jnp.zeros((1, S0), jnp.int32), dev)
+    f = jax.jit(lambda p, t: speculative_generate(
+        p, p, t, cfg, cfg, max_new_tokens=NEW, spec_k=K))
+
+    def settle(r):
+        toks, stats = r
+        toks.block_until_ready()
+        return int(toks[0, 0]), int(stats["target_calls"])
+
+    _, calls = settle(f(params, prompt))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = f(params, prompt)
+        settle(r)
+        best = min(best, time.perf_counter() - t0)
+    return {"new_tokens": NEW, "spec_k": K, "target_calls": calls,
+            "total_ms": best * 1e3, "tokens_per_s_upper_bound": NEW / best}
+
+
 def bench_moe_decode(fast: bool) -> dict:
     """MoE-family serving throughput (models/moe_serve.py): greedy batch
     decode on a Mixtral-style config — top-2 of 8 experts, so ~2/8 of the
@@ -609,6 +648,10 @@ def main(argv=None) -> int:
             extra["moe_decode"] = rounded(bench_moe_decode(args.fast))
         except Exception as e:
             extra["moe_decode_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra["speculative"] = rounded(bench_speculative(args.fast))
+        except Exception as e:
+            extra["speculative_error"] = f"{type(e).__name__}: {e}"
         try:
             extra["train"] = rounded(bench_train_step(args.fast), 4)
             extra["long_context"] = rounded(bench_long_context(args.fast))

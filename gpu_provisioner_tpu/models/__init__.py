@@ -14,6 +14,7 @@ from .decode import (KVCache, generate, init_kv_cache, prefill,
 from .llama import LlamaConfig, forward, init_params, param_specs
 from .moe import MoEConfig, init_moe_model, moe_forward
 from .moe_serve import moe_cached_forward, moe_prefill
+from .speculative import speculative_generate
 from .train import make_train_state, make_train_step
 
 __all__ = [
@@ -21,6 +22,6 @@ __all__ = [
     "make_train_state", "make_train_step",
     "KVCache", "init_kv_cache", "prefill", "prefill_chunked", "generate",
     "MoEConfig", "init_moe_model", "moe_forward",
-    "moe_cached_forward", "moe_prefill",
+    "moe_cached_forward", "moe_prefill", "speculative_generate",
     "save_train_state", "restore_train_state", "TrainCheckpointManager",
 ]

@@ -1,0 +1,154 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Greedy speculative decoding (the Leviathan/Chen scheme's deterministic
+special case): the draft autoregresses ``spec_k`` cheap tokens, the target
+scores all of them in ONE cached forward (a [1, k+1] prefill-shaped call
+instead of k+1 serial decode steps), and the longest prefix where the
+draft's choices equal the target's argmax is accepted, plus one "bonus"
+token from the target's own distribution at the first disagreement.
+
+Output-equality guarantee: greedy speculative decoding emits EXACTLY the
+token stream of plain greedy decoding with the target model — acceptance
+only ever keeps tokens the target itself would have picked. The speedup is
+latency only: ceil(max_new / (accepted+1)) target forwards instead of
+max_new, bought with draft FLOPs (cheap by construction) and wider target
+calls (nearly free: a decode step is HBM-bandwidth-bound on the weights,
+and a [1, k+1] call reads the weights ONCE for k+1 positions — the same
+economics that make batched decode cheap).
+
+TPU shape discipline: everything is static-shape inside one
+``lax.while_loop`` — per-iteration acceptance length is data-dependent,
+so the loop carries (output buffer, emit count, caches) and writes
+fixed-width windows with masking; rollback after partial acceptance is
+just the traced cache ``length`` scalar (keys beyond it are masked out of
+every later attention and overwritten by later writes, so no buffer
+cleanup is needed — the same invariant cached_forward already relies on).
+
+Scope: batch 1 (speculation is a latency tool; per-row acceptance lengths
+would need per-row cache lengths), greedy only, dense/Llama family for
+both models (same vocab required; MoE targets raise until
+moe_cached_forward grows a speculative harness).
+
+Reference parity note: workload-side scope beyond the reference
+(SURVEY.md §2c) — the serving stack KAITO provisions for.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import cached_forward, init_kv_cache, prefill
+from .llama import LlamaConfig
+
+
+def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
+                         draft_cfg: LlamaConfig, *, max_new_tokens: int,
+                         spec_k: int = 4, max_len: int = None):
+    """Greedy generation of ``max_new_tokens`` tokens from the TARGET
+    model, accelerated by the draft. prompt: [1, S0] int32 →
+    (tokens [1, max_new_tokens], stats dict with ``target_calls`` — the
+    number of target forwards actually executed, vs max_new_tokens for
+    plain decoding).
+
+    ``spec_k``: draft tokens proposed per round. Each round emits between
+    1 and spec_k+1 tokens. Both models must share the vocabulary."""
+    from .moe import MoEConfig
+    if isinstance(cfg, MoEConfig) or isinstance(draft_cfg, MoEConfig):
+        raise NotImplementedError(
+            "speculative decoding drives cached_forward directly; the MoE "
+            "family needs the moe_cached_forward harness")
+    B, S0 = prompt.shape
+    if B != 1:
+        raise ValueError(
+            f"speculative decoding is batch-1 (latency tool); got B={B} — "
+            "per-row acceptance would need per-row cache lengths")
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary: "
+                         f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
+    if max_len is None:
+        max_len = S0 + max_new_tokens + spec_k + 1
+    # the verify call may run up to spec_k+1 past the final emission
+    assert S0 + max_new_tokens + spec_k + 1 <= max_len, (
+        S0, max_new_tokens, spec_k, max_len)
+
+    cache_t = init_kv_cache(cfg, 1, max_len)
+    cache_d = init_kv_cache(draft_cfg, 1, max_len)
+    # prefill both; the target's last-position logits give the first token
+    logits_t, cache_t = prefill(params, prompt, cache_t, cfg, fresh=True)
+    _, cache_d = prefill(draft_params, prompt, cache_d, draft_cfg,
+                         fresh=True)
+    tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)     # [1]
+
+    BUF = max_new_tokens + spec_k + 1          # slack for the last window
+    out0 = jnp.zeros((1, BUF), jnp.int32)
+    out0 = out0.at[:, 0].set(tok0)
+
+    def cond(carry):
+        _, n, _, _, _, _ = carry
+        return n < max_new_tokens
+
+    def body(carry):
+        out, n, last, cache_t, cache_d, calls = carry
+
+        # --- draft phase: k+1 serial cheap steps -----------------------
+        # step i consumes token i of [last, d1..dk]; the (k+1)-th write
+        # puts d_k's kv in the draft cache so a fully-accepted round
+        # leaves the draft consistent without a special case
+        def draft_step(c, tok):
+            cache_d = c
+            lg, cache_d = cached_forward(draft_params, tok[None],
+                                         cache_d, draft_cfg)
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return cache_d, nxt
+
+        def draft_scan(c, _):
+            cache_d, tok = c
+            cache_d, nxt = draft_step(cache_d, tok)
+            return (cache_d, nxt), nxt
+
+        (cache_d, _), drafts = lax.scan(
+            draft_scan, (cache_d, last), None, length=spec_k + 1)
+        drafts = drafts.transpose(1, 0)                 # [1, k+1]
+        proposal = drafts[:, :spec_k]                   # d_1..d_k
+
+        # --- target phase: ONE wide verify call ------------------------
+        block = jnp.concatenate([last[:, None], proposal], axis=1)
+        lg, cache_t = cached_forward(params, block, cache_t, cfg)
+        preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [1, k+1]
+        calls = calls + 1
+
+        # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
+        agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)[0]  # scalar
+        emit_n = m + 1                                      # + bonus token
+
+        # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}:
+        # exactly preds[:, :m+1] — write the full fixed window, masked so
+        # positions ≥ emit_n keep their old buffer contents
+        window = lax.dynamic_slice(out, (0, n), (1, spec_k + 1))
+        keep = jnp.arange(spec_k + 1)[None, :] < emit_n
+        out = lax.dynamic_update_slice(
+            out, jnp.where(keep, preds, window), (0, n))
+
+        # --- rollback to the accepted state ----------------------------
+        # target wrote k+1 entries ([last, d1..dk]); accepted needs
+        # [.., last, d1..dm] → drop (k - m). draft wrote k+1 entries
+        # ([last, d1..dk]) and the next round feeds new_last=p_{m+1}, so
+        # it also keeps [.., last, d1..dm] → drop (k - m).
+        cache_t = cache_t._replace(
+            length=cache_t.length - (spec_k - m))
+        cache_d = cache_d._replace(
+            length=cache_d.length - (spec_k - m))
+
+        new_last = preds[jnp.arange(1), m]                  # p_{m+1}, [1]
+        return out, n + emit_n, new_last, cache_t, cache_d, calls
+
+    out, n, _, _, _, calls = lax.while_loop(
+        cond, body, (out0, jnp.asarray(1, jnp.int32), tok0,
+                     cache_t, cache_d, jnp.asarray(1, jnp.int32)))
+    return out[:, :max_new_tokens], {"target_calls": calls,
+                                     "tokens": jnp.minimum(n, max_new_tokens)}

@@ -460,6 +460,42 @@ def test_decode_flash_windowed_padded_matches_dense():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_windowed_int8_kernels_match_dense():
+    """window × int8 cache in BOTH kernels: dequant happens before the
+    windowed tile mask; the composition must stay wired."""
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        flash_attention_cached, flash_attention_decode)
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(24), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    scale = D ** -0.5
+    s = jnp.asarray(320, jnp.int32)
+    out = flash_attention_cached(q, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl),
+                                 window=100)
+    ref = _cached_attention(q, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl), window=100)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
+    out = flash_attention_decode(q1, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl),
+                                 window=100)
+    ref = _cached_attention(q1, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl), window=100)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_sliding_window_validation():
     from gpu_provisioner_tpu.models.llama import resolve_attn
     with pytest.raises(ValueError, match="sliding_window must be positive"):

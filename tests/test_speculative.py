@@ -1,0 +1,77 @@
+"""Speculative decoding (models/speculative.py): greedy spec-decode must
+emit EXACTLY plain greedy's token stream — the acceptance rule only keeps
+tokens the target itself argmaxes. The draft only buys latency."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gpu_provisioner_tpu.models.decode import generate
+from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+from gpu_provisioner_tpu.models.speculative import speculative_generate
+
+CFG_T = LlamaConfig(vocab_size=128, dim=64, n_layers=4, n_heads=4,
+                    n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                    dtype="float32")
+CFG_D = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                    n_kv_heads=1, hidden_dim=64, max_seq_len=512,
+                    dtype="float32")
+
+
+def _models(seed=0):
+    return (init_params(jax.random.key(seed), CFG_T),
+            init_params(jax.random.key(seed + 1), CFG_D))
+
+
+def test_speculative_equals_plain_greedy():
+    """The core guarantee, with an unrelated draft (worst case: most
+    proposals rejected — still exact, just fewer tokens per round)."""
+    params, draft = _models()
+    prompt = jax.random.randint(jax.random.key(5), (1, 24), 0, 128)
+    want = generate(params, prompt, CFG_T, max_new_tokens=24, max_len=256)
+    got, stats = speculative_generate(params, draft, prompt, CFG_T, CFG_D,
+                                      max_new_tokens=24, spec_k=4)
+    assert (got == want).all(), (got, want)
+    assert int(stats["target_calls"]) <= 24
+
+
+def test_speculative_self_draft_max_acceptance():
+    """Draft == target: every proposal is accepted, so each round emits
+    spec_k+1 tokens and target calls collapse to ~max_new/(spec_k+1)."""
+    params, _ = _models()
+    prompt = jax.random.randint(jax.random.key(6), (1, 16), 0, 128)
+    want = generate(params, prompt, CFG_T, max_new_tokens=20, max_len=256)
+    got, stats = speculative_generate(params, params, prompt, CFG_T, CFG_T,
+                                      max_new_tokens=20, spec_k=4)
+    assert (got == want).all()
+    # 20 tokens / 5-per-round = 4 rounds + 1 prefill-emitted token
+    assert int(stats["target_calls"]) <= 5
+
+
+def test_speculative_under_jit():
+    params, draft = _models(seed=2)
+    prompt = jax.random.randint(jax.random.key(7), (1, 16), 0, 128)
+    f = jax.jit(lambda p, d, t: speculative_generate(
+        p, d, t, CFG_T, CFG_D, max_new_tokens=12, spec_k=3))
+    got, stats = f(params, draft, prompt)
+    want = generate(params, prompt, CFG_T, max_new_tokens=12, max_len=256)
+    assert (got == want).all()
+
+
+def test_speculative_validation():
+    params, draft = _models()
+    two_rows = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(params, draft, two_rows, CFG_T, CFG_D,
+                             max_new_tokens=4)
+    import dataclasses
+    bad_vocab = dataclasses.replace(CFG_D, vocab_size=64)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(params, draft, jnp.zeros((1, 8), jnp.int32),
+                             CFG_T, bad_vocab, max_new_tokens=4)
+    from gpu_provisioner_tpu.models.moe import MoEConfig
+    moe_cfg = MoEConfig(vocab_size=128, dim=32, n_layers=1, n_heads=2,
+                        n_kv_heads=1, hidden_dim=64)
+    with pytest.raises(NotImplementedError):
+        speculative_generate(params, draft, jnp.zeros((1, 8), jnp.int32),
+                             moe_cfg, CFG_D, max_new_tokens=4)
