@@ -77,8 +77,7 @@ def main():
     print("logprob:", [round(float(x), 2) for x in lps[0]])
 
     # memory-constrained serving: int8 cache (half the HBM) — same API
-    from dataclasses import replace as _replace
-    cfg8 = _replace(cfg, kv_cache_dtype="int8")
+    cfg8 = replace(cfg, kv_cache_dtype="int8")
     out8 = generate(params, prompt, cfg8, max_new_tokens=8)
     print("int8   :", out8[0].tolist())
 
@@ -115,6 +114,22 @@ def main():
     moe_out = generate(moe_params, moe_prompt, moe_cfg, max_new_tokens=8,
                        max_len=64)
     print("moe    :", moe_out[0].tolist())
+
+    # sliding-window serving (Mistral-style): O(window) cache DMA per
+    # step at any context length — same generate(), one config knob
+    swa_cfg = replace(cfg, sliding_window=8)
+    swa_out = generate(params, prompt, swa_cfg, max_new_tokens=8)
+    print("swa    :", swa_out[0].tolist())
+
+    # speculative decoding: a draft proposes, the target verifies — the
+    # emitted stream is EXACTLY plain greedy's (here self-draft: every
+    # proposal accepted, so target calls collapse ~5x)
+    from gpu_provisioner_tpu.models.speculative import speculative_generate
+    spec_out, stats = speculative_generate(
+        params, params, prompt[:1], cfg, cfg, max_new_tokens=8, spec_k=4)
+    assert (spec_out == greedy[:1, :8]).all()
+    print(f"spec   : {spec_out[0].tolist()} "
+          f"(target calls: {int(stats['target_calls'])} for 8 tokens)")
     print("done")
 
 

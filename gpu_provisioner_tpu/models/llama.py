@@ -72,6 +72,11 @@ PRESETS = {
     "llama-7b": LlamaConfig(),
     "llama-1b": LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
                             hidden_dim=5504),
+    # Mistral-7B-v0.1-shaped: GQA 32/8 + 4k sliding window (the release
+    # that USES the window; theta stays 1e4 to match its checkpoints)
+    "mistral-7b-ish": LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                                  n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                                  max_seq_len=32768, sliding_window=4096),
     "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                         n_kv_heads=2, hidden_dim=128, max_seq_len=128),
 }
