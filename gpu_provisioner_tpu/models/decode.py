@@ -373,6 +373,32 @@ def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
     return logits[:, -1], cache
 
 
+def filter_logits(logits, temperature: float, top_k, top_p):
+    """The serving sampling distribution in one place: temperature →
+    top-k → top-p (standard order). generate() samples from it and
+    speculative_generate accepts/resamples against it — the two MUST stay
+    the same composition or speculative sampling stops preserving the
+    serving distribution (models/speculative.py's correctness theorem)."""
+    logits = logits / temperature
+    if top_k is not None:
+        logits = _filter_top_k(logits, top_k)
+    if top_p is not None:
+        logits = _filter_top_p(logits, top_p)
+    return logits
+
+
+def validate_sampling_args(temperature: float, top_k, top_p, key) -> None:
+    """Shared loud validation for every sampling entry point."""
+    if temperature > 0 and key is None:
+        raise ValueError(
+            "sampling (temperature>0) requires an explicit PRNG key — "
+            "sampling without one would be silently deterministic")
+    if top_k is not None and not 0 < top_k:
+        raise ValueError(f"top_k must be positive, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 def _filter_top_k(logits, top_k: int):
     """Keep the k highest logits per row; the rest → -inf."""
     vals = jax.lax.top_k(logits, top_k)[0]
@@ -429,14 +455,7 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     if max_len is None:
         max_len = S0 + max_new_tokens
     assert S0 + max_new_tokens <= max_len, (S0, max_new_tokens, max_len)
-    if temperature > 0 and key is None:
-        raise ValueError(
-            "generate(temperature>0) requires an explicit PRNG key — "
-            "sampling without one would be silently deterministic")
-    if top_k is not None and not 0 < top_k:
-        raise ValueError(f"top_k must be positive, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    validate_sampling_args(temperature, top_k, top_p, key)
 
     pad_lens = None
     if pad_id is not None:
@@ -468,11 +487,7 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
     def pick(logits, key):
         """(token, logprob-under-the-sampling-distribution) per row."""
         if temperature > 0:
-            logits = logits / temperature
-            if top_k is not None:
-                logits = _filter_top_k(logits, top_k)
-            if top_p is not None:
-                logits = _filter_top_p(logits, top_p)
+            logits = filter_logits(logits, temperature, top_k, top_p)
             tok = jax.random.categorical(key, logits,
                                          axis=-1).astype(jnp.int32)
         else:

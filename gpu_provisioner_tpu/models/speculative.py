@@ -24,10 +24,18 @@ just the traced cache ``length`` scalar (keys beyond it are masked out of
 every later attention and overwritten by later writes, so no buffer
 cleanup is needed — the same invariant cached_forward already relies on).
 
+Sampled mode (temperature > 0): the draft samples its proposals from its
+filtered distribution and the Leviathan/Chen rejection step (_spec_accept)
+accepts proposal i with probability min(1, p_target/p_draft), resampling
+from the normalized residual on rejection — every emitted token's law is
+exactly the target's filtered distribution (statistically verified in
+tests/test_speculative.py), though not token-identical to plain sampled
+generate for a given key (RNG consumption differs).
+
 Scope: batch 1 (speculation is a latency tool; per-row acceptance lengths
-would need per-row cache lengths), greedy only, dense/Llama family for
-both models (same vocab required; MoE targets raise until
-moe_cached_forward grows a speculative harness).
+would need per-row cache lengths), dense/Llama family for both models
+(same vocab required; MoE targets raise until moe_cached_forward grows a
+speculative harness).
 
 Reference parity note: workload-side scope beyond the reference
 (SURVEY.md §2c) — the serving stack KAITO provisions for.
@@ -39,18 +47,57 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .decode import cached_forward, init_kv_cache, prefill
+from .decode import (cached_forward, filter_logits, init_kv_cache, prefill,
+                     validate_sampling_args)
 from .llama import LlamaConfig
+
+
+def _spec_accept(key, proposal, p_d, p_t):
+    """Leviathan/Chen rejection step, factored pure for direct statistical
+    testing: proposal [k] drawn sequentially from the draft distributions
+    p_d [k, V]; p_t [k+1, V] are the target's distributions at the same
+    positions. Returns (m, bonus): accept proposal[i] while
+    u_i < p_t[i, d_i] / p_d[i, d_i]; at the first rejection (position m)
+    the bonus token is drawn from the normalized residual
+    max(p_t[m] − p_d[m], 0) — and from p_t[k] itself when everything was
+    accepted. This makes every emitted token's law EXACTLY the target's
+    (the scheme's correctness theorem), regardless of draft quality."""
+    k = proposal.shape[0]
+    ku, kb = jax.random.split(key)
+    u = jax.random.uniform(ku, (k,))
+    q = jnp.take_along_axis(p_d, proposal[:, None], axis=1)[:, 0]   # q_i(d_i)
+    p = jnp.take_along_axis(p_t[:k], proposal[:, None], axis=1)[:, 0]
+    accept = u < jnp.minimum(1.0, p / jnp.maximum(q, 1e-20))
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))              # scalar
+    # residual at the rejected position; p_t[k] when fully accepted
+    pt_m = jnp.take(p_t, m, axis=0)                                 # [V]
+    pd_m = jnp.take(jnp.concatenate([p_d, jnp.zeros_like(p_d[:1])]),
+                    m, axis=0)                                      # [V]
+    resid = jnp.maximum(pt_m - pd_m, 0.0)
+    s = jnp.sum(resid)
+    probs = jnp.where(s > 0, resid / jnp.maximum(s, 1e-20), pt_m)
+    bonus = jax.random.categorical(kb, jnp.log(jnp.maximum(probs, 1e-30)))
+    return m, bonus.astype(jnp.int32)
 
 
 def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
                          draft_cfg: LlamaConfig, *, max_new_tokens: int,
-                         spec_k: int = 4, max_len: int = None):
-    """Greedy generation of ``max_new_tokens`` tokens from the TARGET
-    model, accelerated by the draft. prompt: [1, S0] int32 →
+                         spec_k: int = 4, max_len: int = None,
+                         temperature: float = 0.0, top_k: int = None,
+                         top_p: float = None, key=None):
+    """Generation of ``max_new_tokens`` tokens from the TARGET model,
+    accelerated by the draft. prompt: [1, S0] int32 →
     (tokens [1, max_new_tokens], stats dict with ``target_calls`` — the
     number of target forwards actually executed, vs max_new_tokens for
     plain decoding).
+
+    ``temperature`` 0 (default) = greedy: output is EXACTLY plain greedy's
+    stream. ``temperature`` > 0 (``key`` REQUIRED, same rule as generate):
+    the draft SAMPLES its proposals from its filtered distribution and the
+    rejection step (_spec_accept) keeps each emitted token's law exactly
+    the target's filtered distribution — distribution-identical to plain
+    sampled generate, though not token-identical for a given key (the RNG
+    is consumed differently).
 
     ``spec_k``: draft tokens proposed per round. Each round emits between
     1 and spec_k+1 tokens. Both models must share the vocabulary."""
@@ -69,6 +116,10 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError("draft and target must share a vocabulary: "
                          f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
+    validate_sampling_args(temperature, top_k, top_p, key)
+    sampled = temperature > 0
+    if not sampled:
+        key = jax.random.key(0)          # threaded but never consumed
     if max_len is None:
         max_len = S0 + max_new_tokens + spec_k + 1
     # the verify call may run up to spec_k+1 past the final emission
@@ -81,74 +132,94 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     logits_t, cache_t = prefill(params, prompt, cache_t, cfg, fresh=True)
     _, cache_d = prefill(draft_params, prompt, cache_d, draft_cfg,
                          fresh=True)
-    tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)     # [1]
+    if sampled:
+        key, k0 = jax.random.split(key)
+        tok0 = jax.random.categorical(
+            k0, filter_logits(logits_t, temperature, top_k, top_p),
+            axis=-1).astype(jnp.int32)                         # [1]
+    else:
+        tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
 
     BUF = max_new_tokens + spec_k + 1          # slack for the last window
     out0 = jnp.zeros((1, BUF), jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
 
     def cond(carry):
-        _, n, _, _, _, _ = carry
-        return n < max_new_tokens
+        return carry[1] < max_new_tokens
 
     def body(carry):
-        out, n, last, cache_t, cache_d, calls = carry
+        out, n, last, cache_t, cache_d, calls, key = carry
+        key, kd, ka = jax.random.split(key, 3)
 
         # --- draft phase: k+1 serial cheap steps -----------------------
         # step i consumes token i of [last, d1..dk]; the (k+1)-th write
         # puts d_k's kv in the draft cache so a fully-accepted round
         # leaves the draft consistent without a special case
-        def draft_step(c, tok):
-            cache_d = c
+        def draft_scan(c, kt):
+            cache_d, tok = c
             lg, cache_d = cached_forward(draft_params, tok[None],
                                          cache_d, draft_cfg)
-            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
-            return cache_d, nxt
+            if sampled:
+                fl = filter_logits(lg[:, 0], temperature, top_k, top_p)
+                probs = jax.nn.softmax(fl, axis=-1)[0]          # [V]
+                nxt = jax.random.categorical(kt, fl,
+                                             axis=-1).astype(jnp.int32)
+            else:
+                probs = jnp.zeros((draft_cfg.vocab_size,))      # unused
+                nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return (cache_d, nxt), (nxt, probs)
 
-        def draft_scan(c, _):
-            cache_d, tok = c
-            cache_d, nxt = draft_step(cache_d, tok)
-            return (cache_d, nxt), nxt
-
-        (cache_d, _), drafts = lax.scan(
-            draft_scan, (cache_d, last), None, length=spec_k + 1)
+        (cache_d, _), (drafts, draft_probs) = lax.scan(
+            draft_scan, (cache_d, last), jax.random.split(kd, spec_k + 1))
         drafts = drafts.transpose(1, 0)                 # [1, k+1]
         proposal = drafts[:, :spec_k]                   # d_1..d_k
 
         # --- target phase: ONE wide verify call ------------------------
         block = jnp.concatenate([last[:, None], proposal], axis=1)
         lg, cache_t = cached_forward(params, block, cache_t, cfg)
-        preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [1, k+1]
         calls = calls + 1
 
-        # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
-        agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
-        m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)[0]  # scalar
-        emit_n = m + 1                                      # + bonus token
+        if sampled:
+            p_t = jax.nn.softmax(
+                filter_logits(lg[0], temperature, top_k, top_p), axis=-1)
+            m, bonus = _spec_accept(ka, proposal[0],
+                                    draft_probs[:spec_k], p_t)
+            # emitted = accepted draft tokens then the bonus draw
+            prop_pad = jnp.concatenate(
+                [proposal[0], jnp.zeros((1,), jnp.int32)])
+            emit_vec = jnp.where(jnp.arange(spec_k + 1) < m,
+                                 prop_pad, bonus)[None, :]
+            new_last = jnp.full((1,), bonus, jnp.int32)
+        else:
+            preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [1, k+1]
+            # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
+            agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)[0]
+            # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}
+            emit_vec = preds
+            new_last = preds[jnp.arange(1), m]                  # p_{m+1}
+        emit_n = m + 1                                          # + bonus
 
-        # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}:
-        # exactly preds[:, :m+1] — write the full fixed window, masked so
-        # positions ≥ emit_n keep their old buffer contents
+        # write the full fixed window, masked so positions ≥ emit_n keep
+        # their old buffer contents
         window = lax.dynamic_slice(out, (0, n), (1, spec_k + 1))
         keep = jnp.arange(spec_k + 1)[None, :] < emit_n
         out = lax.dynamic_update_slice(
-            out, jnp.where(keep, preds, window), (0, n))
+            out, jnp.where(keep, emit_vec, window), (0, n))
 
         # --- rollback to the accepted state ----------------------------
         # target wrote k+1 entries ([last, d1..dk]); accepted needs
         # [.., last, d1..dm] → drop (k - m). draft wrote k+1 entries
-        # ([last, d1..dk]) and the next round feeds new_last=p_{m+1}, so
-        # it also keeps [.., last, d1..dm] → drop (k - m).
+        # ([last, d1..dk]) and the next round feeds new_last, so it also
+        # keeps [.., last, d1..dm] → drop (k - m).
         cache_t = cache_t._replace(
             length=cache_t.length - (spec_k - m))
         cache_d = cache_d._replace(
             length=cache_d.length - (spec_k - m))
+        return out, n + emit_n, new_last, cache_t, cache_d, calls, key
 
-        new_last = preds[jnp.arange(1), m]                  # p_{m+1}, [1]
-        return out, n + emit_n, new_last, cache_t, cache_d, calls
-
-    out, n, _, _, _, calls = lax.while_loop(
+    out, n, _, _, _, calls, _ = lax.while_loop(
         cond, body, (out0, jnp.asarray(1, jnp.int32), tok0,
-                     cache_t, cache_d, jnp.asarray(1, jnp.int32)))
+                     cache_t, cache_d, jnp.asarray(1, jnp.int32), key))
     return out[:, :max_new_tokens], {"target_calls": calls,
                                      "tokens": jnp.minimum(n, max_new_tokens)}
