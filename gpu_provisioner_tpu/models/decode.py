@@ -373,6 +373,27 @@ def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
     return logits[:, -1], cache
 
 
+def family_fns(cfg, pad_lens=None, fresh: bool = False):
+    """(prefill_fn, step_fn), each (params, tokens, cache) → (logits,
+    cache), dispatched on the config's model family — THE dispatch point
+    shared by generate() and speculative_generate so the two can never
+    serve different code paths for the same config. ``fresh``: dense-only
+    fast path for statically-empty caches (ignored for MoE, which has
+    none). Pass fresh=False with pad_lens — the fast path cannot mask pad
+    keys and prefill raises; sliding_window is rerouted inside prefill."""
+    from .moe import MoEConfig
+    if isinstance(cfg, MoEConfig):
+        from .moe_serve import moe_cached_forward, moe_prefill
+        return (lambda p, t, c: moe_prefill(p, t, c, cfg,
+                                            pad_lens=pad_lens),
+                lambda p, t, c: moe_cached_forward(p, t, c, cfg,
+                                                   pad_lens=pad_lens))
+    return (lambda p, t, c: prefill(p, t, c, cfg, fresh=fresh,
+                                    pad_lens=pad_lens),
+            lambda p, t, c: cached_forward(p, t, c, cfg,
+                                           pad_lens=pad_lens))
+
+
 def filter_logits(logits, temperature: float, top_k, top_p):
     """The serving sampling distribution in one place: temperature →
     top-k → top-p (standard order). generate() samples from it and
@@ -463,24 +484,9 @@ def generate(params: dict, prompt, cfg: LlamaConfig, *, max_new_tokens: int,
         pad_lens = jnp.argmax((prompt != pad_id).astype(jnp.int32),
                               axis=1).astype(jnp.int32)
 
-    # MoE family: same loop, MoE-aware forwards (routing per step is
-    # dropless — moe_serve's module docstring owns the semantics)
-    from .moe import MoEConfig
-    if isinstance(cfg, MoEConfig):
-        from .moe_serve import moe_cached_forward, moe_prefill
-        prefill_fn = lambda p, t, c: moe_prefill(p, t, c, cfg,
-                                                 pad_lens=pad_lens)
-        step_fn = lambda p, t, c: moe_cached_forward(p, t, c, cfg,
-                                                     pad_lens=pad_lens)
-    else:
-        # padded prefill runs the general masked forward (fresh fast path
-        # can't exclude pad keys — see prefill)
-        prefill_fn = lambda p, t, c: prefill(p, t, c, cfg,
-                                             fresh=pad_id is None,
-                                             pad_lens=pad_lens)
-        step_fn = lambda p, t, c: cached_forward(p, t, c, cfg,
-                                                 pad_lens=pad_lens)
-
+    # family dispatch (dense vs MoE forwards) — shared with speculative
+    prefill_fn, step_fn = family_fns(cfg, pad_lens=pad_lens,
+                                     fresh=pad_id is None)
     cache = init_kv_cache(cfg, B, max_len)
     logits, cache = prefill_fn(params, prompt, cache)
 

@@ -33,9 +33,21 @@ tests/test_speculative.py), though not token-identical to plain sampled
 generate for a given key (RNG consumption differs).
 
 Scope: batch 1 (speculation is a latency tool; per-row acceptance lengths
-would need per-row cache lengths), dense/Llama family for both models
-(same vocab required; MoE targets raise until moe_cached_forward grows a
-speculative harness).
+would need per-row cache lengths). Both model families serve: dense and
+MoE configs each dispatch to their own cached forward (draft and target
+independently — a dense draft speculating for an MoE target is the
+natural production pairing). Same vocabulary required. MoE-target caveat:
+the wide verify call routes its spec_k+1 tokens with the block's own
+capacity (competition WITHIN the block), while plain decode routes each
+token alone (dropless). Exactness for an MoE target therefore requires
+the verify block to be drop-free in the worst case — capacity(cfg,
+spec_k+1) ≥ spec_k+1, i.e. roughly capacity_factor · experts_per_token
+≥ n_experts. Mixtral-style cf≈1.25 · 2 < 8 does NOT satisfy it: if
+several verify-block tokens pick the same expert, a drop makes the
+verify logits diverge from plain per-token decoding and speculative
+output can differ from plain greedy. Raise capacity_factor for serving
+(capacity is a training-efficiency device) or accept approximate
+equality. Dense targets have no such coupling.
 
 Reference parity note: workload-side scope beyond the reference
 (SURVEY.md §2c) — the serving stack KAITO provisions for.
@@ -47,7 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .decode import (cached_forward, filter_logits, init_kv_cache, prefill,
+from .decode import (family_fns, filter_logits, init_kv_cache,
                      validate_sampling_args)
 from .llama import LlamaConfig
 
@@ -101,11 +113,6 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
 
     ``spec_k``: draft tokens proposed per round. Each round emits between
     1 and spec_k+1 tokens. Both models must share the vocabulary."""
-    from .moe import MoEConfig
-    if isinstance(cfg, MoEConfig) or isinstance(draft_cfg, MoEConfig):
-        raise NotImplementedError(
-            "speculative decoding drives cached_forward directly; the MoE "
-            "family needs the moe_cached_forward harness")
     B, S0 = prompt.shape
     if B != 1:
         raise ValueError(
@@ -126,12 +133,13 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     assert S0 + max_new_tokens + spec_k + 1 <= max_len, (
         S0, max_new_tokens, spec_k, max_len)
 
+    prefill_t, step_t = family_fns(cfg, fresh=True)
+    prefill_d, step_d = family_fns(draft_cfg, fresh=True)
     cache_t = init_kv_cache(cfg, 1, max_len)
     cache_d = init_kv_cache(draft_cfg, 1, max_len)
     # prefill both; the target's last-position logits give the first token
-    logits_t, cache_t = prefill(params, prompt, cache_t, cfg, fresh=True)
-    _, cache_d = prefill(draft_params, prompt, cache_d, draft_cfg,
-                         fresh=True)
+    logits_t, cache_t = prefill_t(params, prompt, cache_t)
+    _, cache_d = prefill_d(draft_params, prompt, cache_d)
     if sampled:
         key, k0 = jax.random.split(key)
         tok0 = jax.random.categorical(
@@ -157,8 +165,7 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         # leaves the draft consistent without a special case
         def draft_scan(c, kt):
             cache_d, tok = c
-            lg, cache_d = cached_forward(draft_params, tok[None],
-                                         cache_d, draft_cfg)
+            lg, cache_d = step_d(draft_params, tok[None], cache_d)
             if sampled:
                 fl = filter_logits(lg[:, 0], temperature, top_k, top_p)
                 probs = jax.nn.softmax(fl, axis=-1)[0]          # [V]
@@ -176,7 +183,7 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
 
         # --- target phase: ONE wide verify call ------------------------
         block = jnp.concatenate([last[:, None], proposal], axis=1)
-        lg, cache_t = cached_forward(params, block, cache_t, cfg)
+        lg, cache_t = step_t(params, block, cache_t)
         calls = calls + 1
 
         if sampled:

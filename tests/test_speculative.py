@@ -69,12 +69,6 @@ def test_speculative_validation():
     with pytest.raises(ValueError, match="vocabulary"):
         speculative_generate(params, draft, jnp.zeros((1, 8), jnp.int32),
                              CFG_T, bad_vocab, max_new_tokens=4)
-    from gpu_provisioner_tpu.models.moe import MoEConfig
-    moe_cfg = MoEConfig(vocab_size=128, dim=32, n_layers=1, n_heads=2,
-                        n_kv_heads=1, hidden_dim=64)
-    with pytest.raises(NotImplementedError):
-        speculative_generate(params, draft, jnp.zeros((1, 8), jnp.int32),
-                             moe_cfg, CFG_D, max_new_tokens=4)
 
 
 def test_spec_accept_preserves_target_distribution():
@@ -118,3 +112,28 @@ def test_speculative_sampled_reproducible_in_vocab():
     with pytest.raises(ValueError, match="PRNG"):
         speculative_generate(params, draft, prompt, CFG_T, CFG_D,
                              max_new_tokens=4, temperature=0.9)
+
+
+def test_speculative_moe_target_dense_draft():
+    """The production pairing: a cheap dense draft speculates for an MoE
+    target — output must equal the MoE model's own plain greedy stream."""
+    from gpu_provisioner_tpu.models.moe import MoEConfig, init_moe_model
+
+    moe_cfg = MoEConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                        n_experts=4, experts_per_token=2,
+                        capacity_factor=8.0, dtype="float32")
+    moe_params = init_moe_model(jax.random.key(9), moe_cfg)
+    _, draft = _models()
+    prompt = jax.random.randint(jax.random.key(10), (1, 16), 0, 128)
+    want = generate(moe_params, prompt, moe_cfg, max_new_tokens=12,
+                    max_len=256)
+    got, stats = speculative_generate(moe_params, draft, prompt, moe_cfg,
+                                      CFG_D, max_new_tokens=12, spec_k=3)
+    assert (got == want).all()
+    # self-draft MoE: full acceptance
+    got2, stats2 = speculative_generate(moe_params, moe_params, prompt,
+                                        moe_cfg, moe_cfg,
+                                        max_new_tokens=12, spec_k=3)
+    assert (got2 == want).all()
+    assert int(stats2["target_calls"]) <= 4
