@@ -353,23 +353,30 @@ def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
     """(last-token logits [B, V], cache) — prefill in ``chunk``-sized
     pieces so peak activation memory is O(chunk·S) instead of O(S²)-ish
     for very long prompts, while each piece still takes the cache-aware
-    flash kernel (blocks tile per chunk). Numerically identical to one
-    cached_forward over the whole prompt: chunk c attends to everything
-    written before it plus its own causal prefix — exactly the full causal
-    mask, evaluated piecewise. Each piece runs through a jitted
-    cached_forward, so at most two programs compile (full chunk +
-    remainder). Call it EAGERLY — under an outer jit the loop unrolls into
-    one trace that grows with S/chunk. The input ``cache`` is DONATED
-    (updated in place on device); don't reuse the passed-in object."""
+    flash kernel (blocks tile per chunk). For the dense family this is
+    numerically identical to one cached_forward over the whole prompt:
+    chunk c attends to everything written before it plus its own causal
+    prefix — exactly the full causal mask, evaluated piecewise. Each piece
+    runs through a jitted cached_forward, so at most two programs compile
+    (full chunk + remainder). Call it EAGERLY — under an outer jit the
+    loop unrolls into one trace that grows with S/chunk. The input
+    ``cache`` is DONATED (updated in place on device); don't reuse the
+    passed-in object.
+
+    MoE family: supported, with a routing-semantics difference — expert
+    capacity is computed PER CHUNK and tokens only compete for expert
+    slots within their chunk (attention is still exact). Whole-prompt
+    routing competes across all S tokens; at capacities where neither
+    drops, the two are identical (tests pin this)."""
     B, S = prompt.shape
     if S == 0 or chunk <= 0:
         raise ValueError(f"need a non-empty prompt (S={S}) and a positive "
                          f"chunk ({chunk})")
+    step = family_step_jit(cfg)
     logits = None
     for off in range(0, S, chunk):
         piece = prompt[:, off:off + chunk]     # slice stop clamps at S
-        logits, cache = _cached_forward_jit(params, piece, cache, cfg,
-                                            pad_lens=pad_lens)
+        logits, cache = step(params, piece, cache, cfg, pad_lens=pad_lens)
     return logits[:, -1], cache
 
 
@@ -392,6 +399,17 @@ def family_fns(cfg, pad_lens=None, fresh: bool = False):
                                     pad_lens=pad_lens),
             lambda p, t, c: cached_forward(p, t, c, cfg,
                                            pad_lens=pad_lens))
+
+
+def family_step_jit(cfg):
+    """The jitted, cache-DONATING cached-forward for the config's family
+    (prefill_chunked's inner step) — lives next to family_fns so family
+    dispatch stays in one place."""
+    from .moe import MoEConfig
+    if isinstance(cfg, MoEConfig):
+        from .moe_serve import _moe_cached_forward_jit
+        return _moe_cached_forward_jit
+    return _cached_forward_jit
 
 
 def filter_logits(logits, temperature: float, top_k, top_p):

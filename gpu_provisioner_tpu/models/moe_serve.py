@@ -133,5 +133,10 @@ def moe_prefill(params: dict, prompt, cache: KVCache, cfg: MoEConfig, *,
     return logits[:, -1], cache
 
 
+# chunked-prefill step (decode.prefill_chunked dispatches here for MoE
+# configs): donated cache, same rationale as decode._cached_forward_jit
+_moe_cached_forward_jit = jax.jit(moe_cached_forward, static_argnums=(3,),
+                                  donate_argnums=(2,))
+
 __all__ = ["moe_cached_forward", "moe_prefill", "init_kv_cache",
            "MoEConfig"]

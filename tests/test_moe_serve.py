@@ -122,3 +122,18 @@ def test_moe_prefill_then_continue_multiturn():
     full, _ = moe_forward(params, stream, CFG)
     np.testing.assert_allclose(np.asarray(logits2), np.asarray(full[:, -1]),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_moe_chunked_prefill_matches_single_shot():
+    """Chunked MoE prefill == single-shot at drop-free capacity (per-chunk
+    and whole-prompt routing agree exactly when neither drops)."""
+    from gpu_provisioner_tpu.models.decode import prefill_chunked
+
+    params, prompt = _setup(B=1, S0=16)
+    single, c1 = moe_prefill(params, prompt,
+                             init_kv_cache(CFG, 1, 64), CFG)
+    chunked, c2 = prefill_chunked(params, prompt,
+                                  init_kv_cache(CFG, 1, 64), CFG, chunk=5)
+    assert int(c2.length) == 16
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(single),
+                               atol=1e-4, rtol=1e-4)
