@@ -106,7 +106,7 @@ def _quantize_kv(x):
 
 def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
                       pad_lens=None, k_scale=None, v_scale=None,
-                      window=None):
+                      window=None, sinks=0):
     """q: [B, S, Hq, Dh] vs the FULL cache width with a validity mask —
     a key at position p is attendable iff p <= start + query_idx (causal,
     and positions beyond the written prefix are masked by the same bound).
@@ -148,15 +148,15 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
             return flash_attention_decode(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
                                           v_scale=v_scale, pad_lens=pad_lens,
-                                          window=window)
+                                          window=window, sinks=sinks)
     if impl == "flash":
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
             return flash_attention_cached(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
-                                          v_scale=v_scale,
-                                          pad_lens=pad_lens, window=window)
+                                          v_scale=v_scale, pad_lens=pad_lens,
+                                          window=window, sinks=sinks)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if k_scale is not None:
@@ -170,12 +170,23 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     q_pos = start + jnp.arange(S)                      # [S]
     mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
     if window is not None:
-        mask = mask & (key_pos[None, :] > q_pos[:, None] - window)
+        in_win = key_pos[None, :] > q_pos[:, None] - window   # [S, K]
+        if sinks and pad_lens is None:
+            # StreamingLLM: the first ``sinks`` keys stay attendable
+            in_win = in_win | (key_pos[None, :] < sinks)
+        mask = mask & in_win
     if pad_lens is None:
         s = jnp.where(mask[None, None, None], s, NEG_INF)
     else:
         live = key_pos[None, None, :] >= pad_lens[:, None, None]  # [B, 1, K]
-        s = jnp.where((mask[None] & live)[:, None, None], s, NEG_INF)
+        bmask = mask[None] & live                                 # [B, S, K]
+        if window is not None and sinks:
+            # per-row sinks: the first ``sinks`` REAL keys (after the pads)
+            sink = (key_pos[None, None, :]
+                    < pad_lens[:, None, None] + sinks)            # [B, 1, K]
+            causal_written = (key_pos[None, :] <= q_pos[:, None])[None]
+            bmask = bmask | (causal_written & live & sink)
+        s = jnp.where(bmask[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgqk,bhkd->bqhgd", p, vf)
     return o.reshape(B, S, Hq, Dh).astype(q.dtype)
@@ -196,7 +207,8 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
     index is traced, so this cannot be checked here; past the bound,
     ``dynamic_update_slice`` clamps and silently corrupts the cache.
     ``generate`` enforces it; manual decode loops must too."""
-    _resolve_attn(cfg.attn_impl, cfg.sliding_window)  # validate loudly — the dense fallback in
+    _resolve_attn(cfg.attn_impl, cfg.sliding_window,
+                  cfg.attn_sinks)  # validate loudly — the dense fallback in
     # _cached_attention is shape-driven, not a typo escape hatch
     ad = cfg.act_dtype
     B, S = tokens.shape
@@ -244,7 +256,8 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
         o = _cached_attention(q, k_cache, v_cache, start, scale,
                               impl=cfg.attn_impl, pad_lens=pad_lens,
                               k_scale=k_scl, v_scale=v_scl,
-                              window=cfg.sliding_window)
+                              window=cfg.sliding_window,
+                              sinks=cfg.attn_sinks)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         h = _mlp_half(h, lp, cfg)

@@ -58,6 +58,16 @@ class LlamaConfig:
                                    # takes the windowed Pallas kernels
                                    # (O(window) cache DMA); the full forward
                                    # masks densely. None = full causal.
+    attn_sinks: int = 0            # StreamingLLM attention sinks: with a
+                                   # sliding window, the first attn_sinks
+                                   # REAL tokens stay attendable forever
+                                   # (ragged rows: the first real tokens
+                                   # after the pads) — long generations
+                                   # keep the softmax's sink mass instead
+                                   # of falling off a quality cliff.
+                                   # Requires sliding_window; serving
+                                   # kernels fetch the sink blocks + the
+                                   # window, still O(window) DMA.
 
     @property
     def head_dim(self) -> int:
@@ -82,7 +92,8 @@ PRESETS = {
 }
 
 
-def resolve_attn(impl: str, window: Optional[int] = None) -> Callable:
+def resolve_attn(impl: str, window: Optional[int] = None,
+                 sinks: int = 0) -> Callable:
     """cfg.attn_impl → attention callable (the one dispatch point — forward,
     the pipelined stage body, and serving prefill all resolve through here).
     Unknown values raise instead of silently running dense.
@@ -97,6 +108,12 @@ def resolve_attn(impl: str, window: Optional[int] = None) -> Callable:
     if impl not in ("flash", "dense"):
         raise ValueError(
             f"unknown attn_impl {impl!r}; expected 'dense'|'flash'")
+    if sinks and window is None:
+        raise ValueError(
+            f"attn_sinks={sinks} requires sliding_window — without a "
+            "window every key is already attendable")
+    if sinks < 0:
+        raise ValueError(f"attn_sinks must be >= 0, got {sinks}")
     if window is not None:
         if window <= 0:
             # window=0 would all-NEG_INF every score row and the impls
@@ -106,7 +123,7 @@ def resolve_attn(impl: str, window: Optional[int] = None) -> Callable:
             raise ValueError(
                 f"sliding_window must be positive, got {window} "
                 "(use None to disable)")
-        return partial(dense_attention, window=window)
+        return partial(dense_attention, window=window, sinks=sinks)
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention
@@ -232,7 +249,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     sequence axis is sharded.
     """
     if attn_fn is None:
-        attn_fn = resolve_attn(cfg.attn_impl, cfg.sliding_window)
+        attn_fn = resolve_attn(cfg.attn_impl, cfg.sliding_window,
+                               cfg.attn_sinks)
     ad = cfg.act_dtype
     B, S = tokens.shape
     if positions is None:

@@ -183,7 +183,7 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, attn_fn=None):
     from .llama import _rope, resolve_attn  # noqa: F401  (rope in the block)
 
     if attn_fn is None:
-        attn_fn = resolve_attn("dense", cfg.sliding_window)
+        attn_fn = resolve_attn("dense", cfg.sliding_window, cfg.attn_sinks)
     ad = cfg.act_dtype
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)
@@ -228,7 +228,8 @@ def make_moe_train_step(mesh, cfg: MoEConfig, optimizer=None):
         optimizer = default_optimizer()
     attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
                            seq_schedule=cfg.seq_schedule,
-                           window=cfg.sliding_window)
+                           window=cfg.sliding_window,
+                           sinks=cfg.attn_sinks)
 
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(moe_loss_fn)(

@@ -47,7 +47,8 @@ def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
     cache.length + S <= max_len), same pad_lens semantics, params in
     init_moe_model's layout: {"backbone": ..., "moe": per-layer experts}.
     """
-    _resolve_attn(cfg.attn_impl, cfg.sliding_window)  # loud validation
+    _resolve_attn(cfg.attn_impl, cfg.sliding_window,
+                  cfg.attn_sinks)  # loud validation
     ad = cfg.act_dtype
     B, S = tokens.shape
     start = cache.length
@@ -94,7 +95,8 @@ def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
         o = _cached_attention(q, k_cache, v_cache, start, scale,
                               impl=cfg.attn_impl, pad_lens=pad_lens,
                               k_scale=k_scl, v_scale=v_scl,
-                              window=cfg.sliding_window)
+                              window=cfg.sliding_window,
+                              sinks=cfg.attn_sinks)
         h = h + o.reshape(B, S, cfg.n_heads * cfg.head_dim) \
             @ lp["wo"].astype(ad)
         m = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)

@@ -37,7 +37,7 @@ def default_optimizer(mu_dtype=None):
 
 def make_attn_fn(mesh, impl: str = "dense",
                  seq_schedule: str = "ring",
-                 window: int = None) -> Callable:
+                 window: int = None, sinks: int = 0) -> Callable:
     """Attention for the mesh: ring over ``seq`` when that axis is sharded;
     otherwise the pallas flash kernel (impl="flash") or dense, shard_mapped
     so each device runs the kernel on its local (batch, head) shard.
@@ -49,7 +49,7 @@ def make_attn_fn(mesh, impl: str = "dense",
     ``window`` (cfg.sliding_window): resolves to the densely-masked window
     path (resolve_attn); composing SWA with a seq-sharded ring schedule is
     not implemented — raise rather than silently train full-causal."""
-    attn = resolve_attn(impl, window)  # validates impl for every branch below
+    attn = resolve_attn(impl, window, sinks)  # validates every branch
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:
         if window is not None:
@@ -146,7 +146,8 @@ def make_train_step(mesh, cfg: LlamaConfig, optimizer=None):
     else:
         attn_fn = make_attn_fn(mesh, impl=cfg.attn_impl,
                                seq_schedule=cfg.seq_schedule,
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window,
+                               sinks=cfg.attn_sinks)
 
     def step(params, opt_state, inputs, targets):
         positions = None
@@ -198,7 +199,8 @@ def make_pipeline_train_step(mesh, cfg: LlamaConfig, n_micro: int = 4,
     if optimizer is None:
         optimizer = default_optimizer()
     state_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ)
-    stage_attn = resolve_attn(cfg.attn_impl, cfg.sliding_window)
+    stage_attn = resolve_attn(cfg.attn_impl, cfg.sliding_window,
+                              cfg.attn_sinks)
 
     def pipelined_forward(params, tokens):
         ad = cfg.act_dtype

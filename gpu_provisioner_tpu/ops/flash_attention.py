@@ -124,14 +124,15 @@ def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
 
 def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
                          q_pos0, kv_pos0, block_q, block_k, scale, masked,
-                         kv_min=None, window=None):
+                         kv_min=None, window=None, sink_hi=None):
     """One flash tile: S = qKᵀ·scale (masked below q_pos0+i ≥ kv_pos0+j when
     ``masked``; additionally below ``kv_min`` ≤ kv_pos0+j when given — the
     left-pad lower bound of ragged serving — and within the sliding
-    ``window`` when given: kv_pos > q_pos − window), then the running-max/
-    denominator update into VMEM scratch. Shared by the streaming
-    self-attention and KV-cache kernels (incl. the int8 variant, which
-    dequantizes before calling) so numerics fixes land in one place.
+    ``window`` when given: kv_pos > q_pos − window, OR'd with the
+    attention-sink range kv_pos < ``sink_hi`` when given), then the
+    running-max/denominator update into VMEM scratch. Shared by the
+    streaming self-attention and KV-cache kernels (incl. the int8 variant,
+    which dequantizes before calling) so numerics fixes land in one place.
     q/k/v are f32 tile VALUES [BQ|BK, D]."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -147,7 +148,10 @@ def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
         if kv_min is not None:
             keep = keep & (kv_pos >= kv_min)
         if window is not None:
-            keep = keep & (kv_pos > q_pos - window)
+            wkeep = kv_pos > q_pos - window
+            if sink_hi is not None:
+                wkeep = wkeep | (kv_pos < sink_hi)
+            keep = keep & wkeep
         s = jnp.where(keep, s, NEG_INF)
     _online_update(s, v, acc_ref, m_ref, l_ref)
 
@@ -222,7 +226,8 @@ def _rows_to_heads(x, B, H):
 
 
 def _causal_kv_index(block_q, block_k, group, causal, *,
-                     prefetch_start=False, pad_hq=None, window=None):
+                     prefetch_start=False, pad_hq=None, window=None,
+                     sinks=0):
     """kv-side index map for (bh, qi, kj) grids. Under causal masking the
     blocks past the diagonal are clamped to the last live block so the block
     index repeats across the dead tail of the kj loop and the Pallas
@@ -244,6 +249,18 @@ def _causal_kv_index(block_q, block_k, group, causal, *,
                 wlo = jnp.maximum(
                     meta_ref[0] + qi * block_q - window + 1, 0)
                 lo_pos = wlo if lo_pos is None else jnp.maximum(lo_pos, wlo)
+            if window is not None and sinks:
+                # two live ranges: the sink blocks walk at identity, the
+                # dead middle clamps forward to the window's first block
+                # (consecutive repeats → single fetch)
+                pad = meta_ref[1 + bh // pad_hq] if pad_hq is not None else 0
+                sink_first = pad // block_k
+                sink_last = jnp.minimum((pad + sinks - 1) // block_k, last)
+                win_idx = jnp.clip(kj, lo_pos // block_k, last)
+                return (bh // g,
+                        jnp.where(kj <= sink_last,
+                                  jnp.clip(kj, sink_first, sink_last),
+                                  win_idx), 0)
             if lo_pos is not None:
                 return (bh // g, jnp.clip(kj, lo_pos // block_k, last), 0)
             return (bh // g, jnp.minimum(kj, last), 0)
@@ -423,7 +440,8 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
 # --- KV-cache (serving) forward --------------------------------------------
 
 def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                   scale, int8, Hq=None, padded=False, window=None):
+                   scale, int8, Hq=None, padded=False, window=None,
+                   sinks=0):
     """Streaming flash where the query block sits at cache positions
     ``start + qi·BQ ..`` against a [max_len]-wide KV cache. ``start`` is a
     traced scalar riding as a scalar-prefetch argument so both the mask and
@@ -463,8 +481,12 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
     if window is not None:
         # the union of row windows is (qmin − window, qmax]; a kv block is
         # dead when it sits entirely at/below the earliest row's lower edge
-        live = live & ((kj + 1) * block_k - 1
-                       >= start + qi * block_q - window + 1)
+        win_live = ((kj + 1) * block_k - 1
+                    >= start + qi * block_q - window + 1)
+        if sinks:
+            # ...unless it overlaps the sink range [pad, pad+sinks)
+            win_live = win_live | (kj * block_k <= pad + sinks - 1)
+        live = live & win_live
 
     @pl.when(live)
     def _step():
@@ -478,7 +500,9 @@ def _kernel_cached(start_ref, q_ref, k_ref, v_ref, *rest, block_q, block_k,
             q_ref[0].astype(jnp.float32), k, v, acc_ref, m_ref, l_ref,
             q_pos0=start + qi * block_q, kv_pos0=kj * block_k,
             block_q=block_q, block_k=block_k, scale=scale, masked=True,
-            kv_min=pad if padded else None, window=window)
+            kv_min=pad if padded else None, window=window,
+            sink_hi=(pad + sinks) if (window is not None and sinks)
+            else None)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -501,7 +525,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
                            block_q: int = None, block_k: int = None,
                            interpret: bool = None,
                            k_scale=None, v_scale=None, pad_lens=None,
-                           window: int = None):
+                           window: int = None, sinks: int = 0):
     """Flash attention of fresh-token queries against a KV cache — the
     serving prefill-continuation path (forward-only, no VJP; decode never
     differentiates). Replaces the dense S×max_len masked sweep of
@@ -566,7 +590,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     kv_idx = _causal_kv_index(block_q, block_k, group, True,
                               prefetch_start=True,
                               pad_hq=Hq if padded else None,
-                              window=window)
+                              window=window, sinks=sinks)
 
     int8 = k_scale is not None
     in_specs = [
@@ -597,7 +621,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
     out = pl.pallas_call(
         functools.partial(_kernel_cached, block_q=block_q, block_k=block_k,
                           scale=scale, int8=int8, Hq=Hq, padded=padded,
-                          window=window),
+                          window=window, sinks=sinks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hq, S, D), q.dtype),
         interpret=interpret,
@@ -608,7 +632,7 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
 # --- KV-cache decode step (S = 1) ------------------------------------------
 
 def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
-                   scale, int8, padded, window=None):
+                   scale, int8, padded, window=None, sinks=0):
     """One generated token's attention against the cache: grid row bh owns
     kv head ``bh % Hkv`` of batch ``bh // Hkv`` and computes ALL ``group``
     of its GQA queries in one pass — the cache tile is fetched once per kv
@@ -634,7 +658,10 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
     if padded:
         live = live & ((kj + 1) * block_k - 1 >= pad)
     if window is not None:
-        live = live & ((kj + 1) * block_k - 1 >= start - window + 1)
+        win_live = (kj + 1) * block_k - 1 >= start - window + 1
+        if sinks:
+            win_live = win_live | (kj * block_k <= pad + sinks - 1)
+        live = live & win_live
 
     @pl.when(live)
     def _step():
@@ -654,7 +681,10 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
         if padded:
             mask = mask & (kv_pos >= pad)
         if window is not None:
-            mask = mask & (kv_pos > start - window)
+            wkeep = kv_pos > start - window
+            if sinks:
+                wkeep = wkeep | (kv_pos < pad + sinks)
+            mask = mask & wkeep
         _online_update(jnp.where(mask, s, NEG_INF), v, acc_ref, m_ref, l_ref)
 
     @pl.when(kj == n_kv - 1)
@@ -673,7 +703,7 @@ def decode_flash_supported(max_len: int, Hq: int, Hkv: int,
 def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
                            block_k: int = None, interpret: bool = None,
                            k_scale=None, v_scale=None, pad_lens=None,
-                           window: int = None):
+                           window: int = None, sinks: int = 0):
     """The serving decode step as a Pallas kernel: ONE new token per row
     ([B, 1, Hq, D] queries at cache position ``start``) against a
     [B, Hkv, max_len, D] head-major cache (forward-only; decode never
@@ -717,11 +747,20 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
         meta = jnp.concatenate([meta, pad_lens.astype(jnp.int32)])
 
     def kv_idx(bh, kj, meta_ref):
-        lo_pos = meta_ref[1 + bh // Hkv] if padded else 0
+        pad = meta_ref[1 + bh // Hkv] if padded else 0
+        lo_pos = pad
         if window is not None:
             lo_pos = jnp.maximum(lo_pos,
                                  jnp.maximum(meta_ref[0] - window + 1, 0))
         hi = meta_ref[0] // block_k
+        if window is not None and sinks:
+            # sink blocks walk at identity; the dead middle clamps forward
+            # to the window's first block (repeats → single fetch)
+            sink_first = pad // block_k
+            sink_last = jnp.minimum((pad + sinks - 1) // block_k, hi)
+            return (bh, jnp.where(kj <= sink_last,
+                                  jnp.clip(kj, sink_first, sink_last),
+                                  jnp.clip(kj, lo_pos // block_k, hi)), 0)
         return (bh, jnp.clip(kj, lo_pos // block_k, hi), 0)
 
     q_idx = lambda bh, kj, meta_ref: (bh, 0, 0)
@@ -754,7 +793,7 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
     out = pl.pallas_call(
         functools.partial(_kernel_decode, Hkv=Hkv, group=group,
                           block_k=block_k, scale=scale, int8=int8,
-                          padded=padded, window=window),
+                          padded=padded, window=window, sinks=sinks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hkv, group, D), q.dtype),
         interpret=interpret,

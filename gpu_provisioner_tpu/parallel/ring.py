@@ -166,7 +166,7 @@ def _ring_flash(q, k, v, *, axis_name, causal, scale, n, my):
 
 def dense_attention_with_lse(q, k, v, *, causal: bool = True,
                              scale: float | None = None,
-                             window: int | None = None):
+                             window: int | None = None, sinks: int = 0):
     """Single-device exact attention returning (out, lse [B,Hq,Sq]) — the
     canonical dense implementation; the lse output is the merge handle the
     flash-ring path needs, and XLA dead-code-eliminates it for callers that
@@ -175,7 +175,9 @@ def dense_attention_with_lse(q, k, v, *, causal: bool = True,
 
     ``window``: sliding-window attention (Mistral-style) — query i attends
     keys in (i - window, i]; composes with ``causal`` (which SWA models
-    always set)."""
+    always set). ``sinks``: StreamingLLM attention sinks — keys at
+    positions < sinks additionally stay attendable (an OR against the
+    window bound, never widening causality)."""
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
@@ -194,7 +196,10 @@ def dense_attention_with_lse(q, k, v, *, causal: bool = True,
         if causal:
             mask = mask & (q_pos >= k_pos)
         if window is not None:
-            mask = mask & (k_pos > q_pos - window)
+            in_win = k_pos > q_pos - window
+            if sinks:
+                in_win = in_win | (k_pos < sinks)
+            mask = mask & in_win
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -208,11 +213,12 @@ def dense_attention_with_lse(q, k, v, *, causal: bool = True,
 
 
 def dense_attention(q, k, v, *, causal: bool = True,
-                    scale: float | None = None, window: int | None = None):
+                    scale: float | None = None, window: int | None = None,
+                    sinks: int = 0):
     """Single-device exact attention (same contract, no mesh axis) — the
     n=1 specialization used by entry()'s single-chip forward."""
     return dense_attention_with_lse(q, k, v, causal=causal, scale=scale,
-                                    window=window)[0]
+                                    window=window, sinks=sinks)[0]
 
 
 # --- zigzag ring: balanced causal schedule ---------------------------------

@@ -163,6 +163,17 @@ def run_cached_checks():
     check("cached_fwd_window",
           fa.flash_attention_cached(q, kc, vc, s, scale=scale, window=100),
           _cached_attention(q, kc, vc, s, scale, window=100), TOL_F32)
+    check("cached_fwd_window_sinks",
+          fa.flash_attention_cached(q, kc, vc, s, scale=scale, window=100,
+                                    sinks=4),
+          _cached_attention(q, kc, vc, s, scale, window=100, sinks=4),
+          TOL_F32)
+    padws = jnp.asarray([0, 17], jnp.int32)
+    check("cached_fwd_window_sinks_padded",
+          fa.flash_attention_cached(q, kc, vc, s, scale=scale, window=100,
+                                    sinks=4, pad_lens=padws),
+          _cached_attention(q, kc, vc, s, scale, window=100, sinks=4,
+                            pad_lens=padws), TOL_F32)
 
     # decode-step kernel (S=1, per-kv-head grid, O(start) DMA)
     q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
@@ -185,6 +196,16 @@ def run_cached_checks():
     check("decode_fwd_window",
           fa.flash_attention_decode(q1, kc, vc, s, scale=scale, window=100),
           _cached_attention(q1, kc, vc, s, scale, window=100), TOL_F32)
+    check("decode_fwd_window_sinks",
+          fa.flash_attention_decode(q1, kc, vc, s, scale=scale, window=100,
+                                    sinks=4),
+          _cached_attention(q1, kc, vc, s, scale, window=100, sinks=4),
+          TOL_F32)
+    check("decode_fwd_window_sinks_padded",
+          fa.flash_attention_decode(q1, kc, vc, s, scale=scale, window=100,
+                                    sinks=4, pad_lens=pad),
+          _cached_attention(q1, kc, vc, s, scale, window=100, sinks=4,
+                            pad_lens=pad), TOL_F32)
 
 
 def run_generate_check():

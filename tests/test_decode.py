@@ -422,3 +422,36 @@ def test_sliding_window_ring_raises():
     mesh = make_mesh(8, sp=2, tp=1)
     with pytest.raises(NotImplementedError):
         make_attn_fn(mesh, impl="dense", window=8)
+
+
+def test_attention_sinks_generate_flash_matches_dense():
+    """cfg.attn_sinks: flash and dense serving agree; sinks change the
+    output once generation runs past the window; ragged row == solo."""
+    import dataclasses
+
+    from gpu_provisioner_tpu.models.llama import LlamaConfig
+
+    cfg_d = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                        dtype="float32", attn_impl="dense",
+                        sliding_window=24, attn_sinks=4)
+    cfg_f = dataclasses.replace(cfg_d, attn_impl="flash")
+    cfg_nosink = dataclasses.replace(cfg_d, attn_sinks=0)
+    params = init_params(jax.random.key(40), cfg_d)
+    prompt = jax.random.randint(jax.random.key(41), (2, 128), 1, 128)
+    td = generate(params, prompt, cfg_d, max_new_tokens=8, max_len=256)
+    tf = generate(params, prompt, cfg_f, max_new_tokens=8, max_len=256)
+    tn = generate(params, prompt, cfg_nosink, max_new_tokens=8, max_len=256)
+    assert (td == tf).all()
+    assert not (td == tn).all()
+
+    # ragged: sinks anchor at each row's first REAL token
+    PAD = 0
+    p1 = prompt[1:, :96]
+    batch = jnp.concatenate(
+        [prompt[:1],
+         jnp.concatenate([jnp.full((1, 32), PAD, jnp.int32), p1], 1)], 0)
+    got = generate(params, batch, cfg_d, max_new_tokens=6, max_len=256,
+                   pad_id=PAD)
+    solo1 = generate(params, p1, cfg_d, max_new_tokens=6, max_len=256)
+    assert (got[1] == solo1[0]).all()

@@ -542,3 +542,86 @@ def test_flash_under_shard_map_on_mesh():
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_attention_sinks_kernels_match_dense():
+    """StreamingLLM sinks: first-N keys stay attendable beyond the window,
+    in both serving kernels and the dense sweep (incl. a start where the
+    window has moved far past the sinks — the regime sinks exist for)."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        flash_attention_cached, flash_attention_decode)
+
+    B, S, ML, Hq, Hkv, D = 2, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(25), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    W, SK = 64, 4
+    s = jnp.asarray(320, jnp.int32)      # window floor 320-64 >> sinks
+    out = flash_attention_cached(q, kc, vc, s, scale=scale, window=W,
+                                 sinks=SK)
+    ref = _cached_attention(q, kc, vc, s, scale, window=W, sinks=SK)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # the sinks must actually matter at this start
+    ref_nosink = _cached_attention(q, kc, vc, s, scale, window=W)
+    assert float(jnp.max(jnp.abs(ref - ref_nosink))) > 1e-3
+
+    q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
+    out = flash_attention_decode(q1, kc, vc, s, scale=scale, window=W,
+                                 sinks=SK)
+    ref = _cached_attention(q1, kc, vc, s, scale, window=W, sinks=SK)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_attention_sinks_padded_rows():
+    """Ragged rows: the sinks are the first REAL tokens (after the pads) —
+    per-row sink ranges in both kernels match the dense reference."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        flash_attention_cached, flash_attention_decode)
+
+    B, S, ML, Hq, Hkv, D = 3, 128, 512, 4, 2, 32
+    ks = jax.random.split(jax.random.key(26), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    pad = jnp.asarray([0, 17, 140], jnp.int32)
+    scale = D ** -0.5
+    s = jnp.asarray(320, jnp.int32)
+    out = flash_attention_cached(q, kc, vc, s, scale=scale, pad_lens=pad,
+                                 window=64, sinks=4)
+    ref = _cached_attention(q, kc, vc, s, scale, pad_lens=pad, window=64,
+                            sinks=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    q1 = jax.random.normal(ks[0], (B, 1, Hq, D))
+    out = flash_attention_decode(q1, kc, vc, s, scale=scale, pad_lens=pad,
+                                 window=64, sinks=4)
+    ref = _cached_attention(q1, kc, vc, s, scale, pad_lens=pad, window=64,
+                            sinks=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_attention_sinks_validation_and_dense():
+    from gpu_provisioner_tpu.models.llama import resolve_attn
+    with pytest.raises(ValueError, match="requires sliding_window"):
+        resolve_attn("dense", None, 4)
+    with pytest.raises(ValueError, match="attn_sinks must be"):
+        resolve_attn("dense", 32, -1)
+    # dense self-attention reference vs brute force
+    q, k, v = _qkv(B=1, S=64, Hq=2, Hkv=2, D=16)
+    W, SK = 16, 2
+    out = dense_attention(q, k, v, causal=True, window=W, sinks=SK)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (16 ** -0.5)
+    qp = jnp.arange(64)[:, None]
+    kp = jnp.arange(64)[None, :]
+    mask = (qp >= kp) & ((kp > qp - W) | (kp < SK))
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
