@@ -98,13 +98,15 @@ def resolve_attn(impl: str, window: Optional[int] = None,
     the pipelined stage body, and serving prefill all resolve through here).
     Unknown values raise instead of silently running dense.
 
-    ``window`` (cfg.sliding_window): the SELF-attention path masks densely
-    — windowed Pallas kernels exist on the KV-cache serving path
-    (ops/flash_attention.py:flash_attention_cached/_decode), where the
-    O(window) DMA bound pays; a windowed self-attention kernel would also
-    need a windowed backward, which nothing needs yet. Correctness first:
-    with a window set, impl="flash" deliberately resolves to the masked
-    dense path rather than silently dropping the window."""
+    ``window`` (cfg.sliding_window): impl="flash" takes the windowed
+    Pallas kernels — forward AND recompute backward prune to the window
+    band (loop bounds, live gates, and kv index-map clamps), so
+    Mistral-style long-context training is O(S·window) compute and
+    O(S·D) memory where the dense mask cannot even compile at 32k.
+    impl="dense" masks densely. ``sinks`` (cfg.attn_sinks) stays on the
+    dense path for self-attention — sinks matter in long GENERATION,
+    which runs the serving kernels; a windowed+sinks full forward is
+    rare enough that correct-but-dense is the right cost."""
     if impl not in ("flash", "dense"):
         raise ValueError(
             f"unknown attn_impl {impl!r}; expected 'dense'|'flash'")
@@ -123,6 +125,9 @@ def resolve_attn(impl: str, window: Optional[int] = None,
             raise ValueError(
                 f"sliding_window must be positive, got {window} "
                 "(use None to disable)")
+        if impl == "flash" and not sinks:
+            from ..ops.flash_attention import flash_attention
+            return partial(flash_attention, window=window)
         return partial(dense_attention, window=window, sinks=sinks)
     if impl == "flash":
         from ..ops.flash_attention import flash_attention

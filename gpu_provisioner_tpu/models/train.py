@@ -46,9 +46,10 @@ def make_attn_fn(mesh, impl: str = "dense",
     seq permutation outside the shard_map — GSPMD lowers the gathers to
     all-to-alls on ICI, negligible next to the O(S²/n) attention saved.
 
-    ``window`` (cfg.sliding_window): resolves to the densely-masked window
-    path (resolve_attn); composing SWA with a seq-sharded ring schedule is
-    not implemented — raise rather than silently train full-causal."""
+    ``window`` (cfg.sliding_window): impl="flash" takes the windowed
+    Pallas kernels (O(S·window) — see resolve_attn); composing SWA with a
+    seq-sharded ring schedule is not implemented — raise rather than
+    silently train full-causal."""
     attn = resolve_attn(impl, window, sinks)  # validates every branch
     qkv_spec = P((AXIS_SLICE, AXIS_DATA), AXIS_SEQ, AXIS_MODEL, None)
     if mesh.shape[AXIS_SEQ] > 1:

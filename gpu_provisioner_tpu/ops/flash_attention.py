@@ -68,16 +68,20 @@ RESIDENT_KV_BUDGET = 6 * 1024 * 1024
 
 
 def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                     seq_len, scale, causal):
+                     seq_len, scale, causal, window=None):
     """Whole-K/V-in-VMEM variant: one DMA of K/V per (bh, q-block), inner
     fori_loop over tiles. Fastest at short/medium S (fewer HBM round trips,
-    causal loop-bound pruning); VMEM-bounded, so only used under budget."""
+    causal loop-bound pruning); VMEM-bounded, so only used under budget.
+    ``window``: the loop's LOWER bound prunes to the window band too."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                      # [BQ, D]
     if causal:
         n_blocks = (qi * block_q + block_q - 1) // block_k + 1
     else:
         n_blocks = seq_len // block_k
+    lo_blocks = 0
+    if window is not None:
+        lo_blocks = jnp.maximum(qi * block_q - window + 1, 0) // block_k
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)
 
@@ -88,10 +92,15 @@ def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             kv_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            keep = jnp.ones(s.shape, jnp.bool_)
+            if causal:
+                keep = q_pos >= kv_pos
+            if window is not None:
+                keep = keep & (kv_pos > q_pos - window)
+            s = jnp.where(keep, s, NEG_INF)
         m_blk = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new)
@@ -106,20 +115,21 @@ def _kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(lo_blocks, n_blocks, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
     lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), NEG_INF)
     lse_ref[0] = lse                                      # [BQ, 1]
 
 
 def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
-                         q_pos0, kv_pos0, block_q, block_k, scale, masked):
+                         q_pos0, kv_pos0, block_q, block_k, scale, masked,
+                         window=None):
     """One flash tile from refs — see _online_softmax_tile."""
     _online_softmax_tile(
         q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
         v_ref[0].astype(jnp.float32), acc_ref, m_ref, l_ref,
         q_pos0=q_pos0, kv_pos0=kv_pos0, block_q=block_q, block_k=block_k,
-        scale=scale, masked=masked)
+        scale=scale, masked=masked, window=window)
 
 
 def _online_softmax_tile(q, k, v, acc_ref, m_ref, l_ref, *,
@@ -190,7 +200,7 @@ def _finalize_out(o_ref, acc_ref, m_ref, l_ref, lse_ref=None):
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-            block_q, block_k, scale, causal):
+            block_q, block_k, scale, causal, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -201,13 +211,17 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     # whole block above the causal diagonal → no compute
     live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    if window is not None:
+        live = live & ((kj + 1) * block_k - 1
+                       >= qi * block_q - window + 1)
 
     @pl.when(live)
     def _step():
         _online_softmax_step(
             q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
             q_pos0=qi * block_q, kv_pos0=kj * block_k,
-            block_q=block_q, block_k=block_k, scale=scale, masked=causal)
+            block_q=block_q, block_k=block_k, scale=scale, masked=causal,
+            window=window)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -270,6 +284,9 @@ def _causal_kv_index(block_q, block_k, group, causal, *,
 
     def idx(bh, qi, kj, g=group):
         last = (qi * block_q + block_q - 1) // block_k
+        if window is not None:
+            first = jnp.maximum(qi * block_q - window + 1, 0) // block_k
+            return (bh // g, jnp.clip(kj, first, last), 0)
         return (bh // g, jnp.minimum(kj, last), 0)
     return idx
 
@@ -311,22 +328,27 @@ def _kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         _finalize_out(o_ref, acc_ref, m_ref, l_ref, lse_ref)
 
 
-def _causal_q_index(block_q, block_k, causal):
+def _causal_q_index(block_q, block_k, causal, window=None):
     """q-side index map for (bh, kj, qi) grids (the dK/dV pass). The dead
     prefix of the qi loop (blocks strictly before the diagonal) is clamped
     UP to the first live block — the same index repeats from step 0 through
-    the first live step, so those DMAs are elided too."""
+    the first live step, so those DMAs are elided too. ``window``: the
+    dead TAIL (queries past the kv block's window reach) clamps DOWN
+    likewise."""
     if not causal:
         return lambda bh, kj, qi: (bh, qi, 0)
 
     def idx(bh, kj, qi):
         first = (kj * block_k) // block_q
+        if window is not None:
+            last = (kj * block_k + block_k - 1 + window - 1) // block_q
+            return (bh, jnp.clip(qi, first, last), 0)
         return (bh, jnp.maximum(qi, first), 0)
     return idx
 
 
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-           triangular=False):
+           triangular=False, window=None):
     """Flash forward on flattened heads → (out [B,S,Hq,D], lse [B*Hq, S, 1])."""
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -345,7 +367,7 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
     if kv_bytes <= RESIDENT_KV_BUDGET:
         kernel = functools.partial(
             _kernel_resident, block_q=block_q, block_k=block_k, seq_len=S,
-            scale=scale, causal=causal)
+            scale=scale, causal=causal, window=window)
         out, lse = pl.pallas_call(
             kernel,
             grid=(B * Hq, S // block_q),
@@ -368,8 +390,10 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
         )(qf, kf, vf)
         return _rows_to_heads(out, B, Hq), lse
 
-    if causal and triangular and block_q == block_k:
+    if causal and triangular and block_q == block_k and window is None:
         # flattened-triangle grid: above-diagonal cells don't exist at all
+        # (window stays on the rectangular grids — its clamps express the
+        # band directly)
         # (the rectangular variant below predicates them off and elides
         # their DMA, but still pays the grid step)
         n_q = S // block_q
@@ -404,13 +428,15 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
         return _rows_to_heads(out, B, Hq), lse
 
     kernel = functools.partial(
-        _kernel, block_q=block_q, block_k=block_k, scale=scale, causal=causal)
+        _kernel, block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        window=window)
     # Causal: kv blocks above the diagonal are dead. Clamping their index to
     # the last live block makes the index map constant across the dead tail
     # of the kj loop, so the pipeline elides the re-fetch — fully-masked
     # blocks cost neither compute (the `live` gate in the kernel) nor HBM
     # traffic (this clamp). At long S that halves K/V read traffic.
-    kv_idx = _causal_kv_index(block_q, block_k, group, causal)
+    kv_idx = _causal_kv_index(block_q, block_k, group, causal,
+                              window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * Hq, S // block_q, S // block_k),
@@ -804,7 +830,7 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------
 
 def _rebuild_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
-                  qi, kj, block_q, block_k, scale, causal):
+                  qi, kj, block_q, block_k, scale, causal, window=None):
     """Recompute one tile's P = exp(S − lse) (fully-masked-row guarded) and
     dS = P ∘ (dP − Δ)·scale — the shared core of both backward passes
     (FlashAttention-2 §3.2); only the final accumulation matmuls differ.
@@ -819,12 +845,17 @@ def _rebuild_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-    if causal:
+    if causal or window is not None:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, 1), 0)
         kv_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        keep = jnp.ones(s.shape, jnp.bool_)
+        if causal:
+            keep = q_pos >= kv_pos
+        if window is not None:
+            keep = keep & (kv_pos > q_pos - window)
+        s = jnp.where(keep, s, NEG_INF)
     p = jnp.exp(s - lse)
     p = jnp.where(lse > NEG_INF / 2, p, 0.0)            # fully-masked rows
     dp = jax.lax.dot_general(
@@ -835,19 +866,20 @@ def _rebuild_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 
 def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc, *,
-                 qi, kj, block_q, block_k, scale, causal):
+                 qi, kj, block_q, block_k, scale, causal, window=None):
     """One dQ tile: dQ_i += dS_ij K_j. Shared by the rectangular and
     triangular dq grids."""
     _, k, _, _, ds = _rebuild_p_ds(
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi=qi, kj=kj,
-        block_q=block_q, block_k=block_k, scale=scale, causal=causal)
+        block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        window=window)
     dq_acc[:] += jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, block_q, block_k, scale, causal):
+                   dq_acc, *, block_q, block_k, scale, causal, window=None):
     """dQ accumulated over kv-blocks in VMEM scratch (rectangular grid)."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -858,12 +890,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    if window is not None:
+        live = live & ((kj + 1) * block_k - 1
+                       >= qi * block_q - window + 1)
 
     @pl.when(live)
     def _step():
         _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_acc, qi=qi, kj=kj, block_q=block_q, block_k=block_k,
-                     scale=scale, causal=causal)
+                     scale=scale, causal=causal, window=window)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -890,12 +925,14 @@ def _bwd_dq_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_acc,
-                  dv_acc, *, qi, kj, block_q, block_k, scale, causal):
+                  dv_acc, *, qi, kj, block_q, block_k, scale, causal,
+                  window=None):
     """One dK/dV tile: dV_j += P_ijᵀ dO_i ; dK_j += dS_ijᵀ Q_i. Shared by
     the rectangular and reversed-triangle dkv grids."""
     q, _, do, p, ds = _rebuild_p_ds(
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi=qi, kj=kj,
-        block_q=block_q, block_k=block_k, scale=scale, causal=causal)
+        block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        window=window)
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)             # [BK, D]
@@ -906,7 +943,7 @@ def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_acc,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
-                    scale, causal):
+                    scale, causal, window=None):
     """dK/dV accumulated over q-blocks. Grid is (bh, kv-block, q-block)."""
     kj = pl.program_id(1)
     qi = pl.program_id(2)
@@ -918,12 +955,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+    if window is not None:
+        # queries past kv_max + window − 1 can't see this kv block
+        live = live & (qi * block_q
+                       <= kj * block_k + block_k - 1 + window - 1)
 
     @pl.when(live)
     def _step():
         _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_acc, dv_acc, qi=qi, kj=kj, block_q=block_q,
-                      block_k=block_k, scale=scale, causal=causal)
+                      block_k=block_k, scale=scale, causal=causal,
+                      window=window)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -963,7 +1005,7 @@ def _bwd_dkv_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                    interpret, g_lse=None, triangular=False):
+                    interpret, g_lse=None, triangular=False, window=None):
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
@@ -980,7 +1022,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         # because ∂lse/∂S = P — the kernels run unchanged on Δ' = Δ − ḡ.
         delta = delta - g_lse.astype(jnp.float32)
 
-    if causal and triangular and block_q == block_k:
+    if causal and triangular and block_q == block_k and window is None:
         return _flash_bwd_tri(qf, kf, vf, dof, lse, delta, B, S, Hq, Hkv,
                               D, group, scale, block_q, interpret, q, k, v)
 
@@ -988,14 +1030,15 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                          memory_space=pltpu.VMEM)
     # same dead-block DMA elision as the forward (see _causal_kv_index)
     kvspec = pl.BlockSpec((1, block_k, D),
-                          _causal_kv_index(block_q, block_k, group, causal),
+                          _causal_kv_index(block_q, block_k, group, causal,
+                                           window=window),
                           memory_space=pltpu.VMEM)
     rowq = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0),
                         memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+                          scale=scale, causal=causal, window=window),
         grid=(B * Hq, S // block_q, S // block_k),
         in_specs=[qspec, kvspec, kvspec, qspec, rowq, rowq],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
@@ -1009,7 +1052,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     # their kv-head after the kernel — keeps grid cells race-free.
     # q-side dead-prefix elision (see _causal_q_index); kv blocks are
     # indexed by the outer kj and already fetched once per kv grid row.
-    q_idx2 = _causal_q_index(block_q, block_k, causal)
+    q_idx2 = _causal_q_index(block_q, block_k, causal, window=window)
     qspec2 = pl.BlockSpec((1, block_q, D), q_idx2, memory_space=pltpu.VMEM)
     kvspec2 = pl.BlockSpec((1, block_k, D),
                            lambda bh, kj, qi, g_=group: (bh // g_, kj, 0),
@@ -1019,7 +1062,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+                          scale=scale, causal=causal, window=window),
         grid=(B * Hq, S // block_k, S // block_q),
         in_specs=[qspec2, kvspec2, kvspec2, qspec2, rowq2, rowq2],
         out_specs=[dkv_out, dkv_out],
@@ -1096,32 +1139,32 @@ def _flash_bwd_tri(qf, kf, vf, dof, lse, delta, B, S, Hq, Hkv, D, group,
             _rows_to_heads(dv.astype(v.dtype), B, Hkv))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_lse_diff(q, k, v, causal, scale, block_q, block_k, interpret,
-                    triangular):
+                    triangular, window):
     out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-                      triangular)
+                      triangular, window)
     B, _, Hq, _ = q.shape
     return out, lse.reshape(B, Hq, -1)
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   triangular):
+                   triangular, window):
     out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-                      triangular)
+                      triangular, window)
     B, _, Hq, _ = q.shape
     return (out, lse.reshape(B, Hq, -1)), (q, k, v, out, lse)
 
 
 def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, triangular,
-                   res, g):
+                   window, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
     B, S, Hq, _ = q.shape
     return _flash_bwd_impl(q, k, v, o, lse, g_out, causal, scale, block_q,
                            block_k, interpret,
                            g_lse=g_lse.reshape(B * Hq, S, 1),
-                           triangular=triangular)
+                           triangular=triangular, window=window)
 
 
 _flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -1130,7 +1173,7 @@ _flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              scale: float = None, block_q: int = None,
                              block_k: int = None, interpret: bool = None,
-                             triangular: bool = False):
+                             triangular: bool = False, window: int = None):
     """flash_attention that also returns the per-row logsumexp [B, Hq, S] —
     the combination handle ring attention needs to merge partial attentions
     across ring steps (parallel/ring.py). Differentiable in both outputs.
@@ -1154,16 +1197,18 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     tiles = (S % block_q == 0 and S % block_k == 0 and Hq % Hkv == 0
              and q.shape[1] == k.shape[1])
     if not tiles:
-        return dense_attention_with_lse(q, k, v, causal=causal, scale=scale)
+        return dense_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                        window=window)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     return _flash_lse_diff(q, k, v, causal, scale, block_q, block_k,
-                           interpret, triangular)
+                           interpret, triangular, window)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
                     block_q: int = None, block_k: int = None,
-                    interpret: bool = None, triangular: bool = False):
+                    interpret: bool = None, triangular: bool = False,
+                    window: int = None):
     """Drop-in for dense_attention: q [B,S,Hq,D], k/v [B,S,Hkv,D] → [B,S,Hq,D].
 
     Takes the Pallas kernel only when S tiles exactly into the given
@@ -1175,4 +1220,4 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
     return flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
                                     interpret=interpret,
-                                    triangular=triangular)[0]
+                                    triangular=triangular, window=window)[0]

@@ -625,3 +625,70 @@ def test_attention_sinks_validation_and_dense():
     ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [32, 100, 1000])
+def test_flash_windowed_self_attention_matches_dense(window):
+    """Windowed flash SELF-attention (training path): forward and the
+    per-block-recompute backward vs the dense windowed mask — resident
+    variant, windows smaller than / straddling / larger than S."""
+    # explicit 128-blocks at S=512: the grid has dead/partial blocks, so
+    # the band arithmetic (lo_blocks, live gates, index clamps) is real
+    q, k, v = _qkv(B=1, S=512, Hq=2, Hkv=1, D=32)
+    ref = dense_attention(q, k, v, window=window)
+    out = flash_attention(q, k, v, window=window, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda *a: jnp.sum(
+        flash_attention(*a, window=window, block_q=128,
+                        block_k=128) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.sum(
+        dense_attention(*a, window=window) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_windowed_streaming_matches_dense(monkeypatch):
+    """Streaming grid with window: live gates + kv index clamps prune to
+    the band; forward and backward must stay exact."""
+    import importlib
+    fa_mod = importlib.import_module("gpu_provisioner_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa_mod, "RESIDENT_KV_BUDGET", 0)
+    q, k, v = _qkv(B=1, S=512, Hq=2, Hkv=1, D=32)
+    W = 100
+    ref = dense_attention(q, k, v, window=W)
+    out = fa_mod.flash_attention(q, k, v, window=W, block_q=128,
+                                 block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda *a: jnp.sum(
+        fa_mod.flash_attention(*a, window=W, block_q=128,
+                               block_k=128) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.sum(
+        dense_attention(*a, window=W) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_windowed_lse_and_resolve():
+    """with_lse carries the windowed logsumexp; resolve_attn routes
+    impl='flash' + window to the kernel (and sinks back to dense)."""
+    from gpu_provisioner_tpu.models.llama import resolve_attn
+    from gpu_provisioner_tpu.ops.flash_attention import (
+        flash_attention, flash_attention_with_lse)
+    from gpu_provisioner_tpu.parallel.ring import dense_attention_with_lse
+
+    q, k, v = _qkv(B=1, S=256, Hq=2, Hkv=2, D=32)
+    of, lf = flash_attention_with_lse(q, k, v, window=64)
+    od, ld = dense_attention_with_lse(q, k, v, window=64)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(od),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(ld),
+                               atol=2e-5, rtol=2e-5)
+    fn = resolve_attn("flash", 64)
+    assert fn.func is flash_attention              # real kernel routing
+    np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(od),
+                               atol=2e-5, rtol=2e-5)
