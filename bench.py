@@ -285,19 +285,47 @@ def bench_long_context(fast: bool) -> dict:
     put = lambda x: jax.device_put(x, NamedSharding(mesh, BATCH_SPEC))
     inp, tgt = put(toks[:, :-1]), put(toks[:, 1:])
 
-    # TWO warm steps: donation changes the arg layouts after the first call,
-    # which triggers a second compile — timing step 2 would measure it.
-    for _ in range(2):
-        params, opt_state, loss = step(params, opt_state, inp, tgt)
-        loss.block_until_ready()
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, inp, tgt)
-        loss.block_until_ready()
-        float(loss)
-        best = min(best, time.perf_counter() - t0)
-    return {"seq_len": S, "step_ms": best * 1e3}
+    def time_step(step, params, opt_state, inp, tgt):
+        # TWO warm steps: donation changes the arg layouts after the first
+        # call, which triggers a second compile — timing step 2 would
+        # measure it.
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, inp, tgt)
+            loss.block_until_ready()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            params, opt_state, loss = step(params, opt_state, inp, tgt)
+            loss.block_until_ready()
+            float(loss)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    out = {"seq_len": S,
+           "step_ms": time_step(step, params, opt_state, inp, tgt) * 1e3}
+
+    if impl == "flash":
+        # Mistral-style SWA training: the windowed kernels prune fwd+bwd
+        # to the window band, so step time scales with S·window, not S² —
+        # the regime where windowed models TRAIN at context lengths the
+        # full causal kernel pays quadratically for. Flash-only: the dense
+        # window mask still builds the S² score matrix, so there is
+        # nothing meaningful to measure off-TPU.
+        import dataclasses
+        S2 = S * 2
+        cfg_w = dataclasses.replace(cfg, max_seq_len=S2,
+                                    sliding_window=1024)
+        params, opt_state, opt = make_train_state(jax.random.key(0), cfg_w,
+                                                  mesh)
+        step = make_train_step(mesh, cfg_w, opt)
+        toks = jax.random.randint(jax.random.key(1), (1, S2 + 1), 0,
+                                  cfg_w.vocab_size)
+        out["swa_seq_len"] = S2
+        out["swa_window"] = cfg_w.sliding_window
+        out["swa_step_ms"] = time_step(step, params, opt_state,
+                                       put(toks[:, :-1]),
+                                       put(toks[:, 1:])) * 1e3
+    return out
 
 
 def bench_decode(fast: bool) -> dict:

@@ -67,6 +67,13 @@ def run_forward_checks():
                   fa.flash_attention(q, k, v, causal=causal),
                   dense_attention(q, k, v, causal=causal), TOL_F32)
 
+    # windowed self-attention (training path): multi-block band grids
+    q, k, v = _qkv(S=512)
+    check("resident_fwd_window",
+          fa.flash_attention(q, k, v, window=100, block_q=128,
+                             block_k=128),
+          dense_attention(q, k, v, window=100), TOL_F32)
+
     # streaming grid: force it by zeroing the residency budget
     saved = fa.RESIDENT_KV_BUDGET
     fa.RESIDENT_KV_BUDGET = 0
@@ -80,6 +87,10 @@ def run_forward_checks():
         check("triangular_fwd",
               fa.flash_attention(q, k, v, triangular=True),
               dense_attention(q, k, v), TOL_F32)
+        check("streaming_fwd_window",
+              fa.flash_attention(q, k, v, window=200, block_q=128,
+                                 block_k=128),
+              dense_attention(q, k, v, window=200), TOL_F32)
     finally:
         fa.RESIDENT_KV_BUDGET = saved
 
@@ -101,6 +112,15 @@ def run_backward_checks():
             for nm, a, b in zip(("dq", "dk", "dv"), ga, gb):
                 check(f"resident_bwd_{nm}_causal={causal}_hkv={Hkv}",
                       a, b, TOL_GRAD)
+
+    # windowed backward (training path): band-pruned dQ/dKV kernels
+    q, k, v = _qkv(B=1, S=512, Hq=2, Hkv=1, D=64)
+    ga, gb = gpair(
+        lambda *a: fa.flash_attention(*a, window=100, block_q=128,
+                                      block_k=128),
+        lambda *a: dense_attention(*a, window=100), q, k, v)
+    for nm, a, b in zip(("dq", "dk", "dv"), ga, gb):
+        check(f"windowed_bwd_{nm}", a, b, TOL_GRAD)
 
     saved = fa.RESIDENT_KV_BUDGET
     fa.RESIDENT_KV_BUDGET = 0
