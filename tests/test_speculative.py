@@ -137,3 +137,18 @@ def test_speculative_moe_target_dense_draft():
                                         max_new_tokens=12, spec_k=3)
     assert (got2 == want).all()
     assert int(stats2["target_calls"]) <= 4
+
+
+def test_speculative_swa_sinks_target():
+    """Speculation composes with sliding-window + sinks targets: the
+    verify/prefill calls route through the windowed serving kernels and
+    greedy equality with plain generate still holds."""
+    import dataclasses
+
+    cfg_t = dataclasses.replace(CFG_T, sliding_window=16, attn_sinks=2)
+    params, draft = _models(seed=5)
+    prompt = jax.random.randint(jax.random.key(12), (1, 24), 0, 128)
+    want = generate(params, prompt, cfg_t, max_new_tokens=16, max_len=256)
+    got, stats = speculative_generate(params, draft, prompt, cfg_t, CFG_D,
+                                      max_new_tokens=16, spec_k=3)
+    assert (got == want).all()
