@@ -96,7 +96,7 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
                          draft_cfg: LlamaConfig, *, max_new_tokens: int,
                          spec_k: int = 4, max_len: int = None,
                          temperature: float = 0.0, top_k: int = None,
-                         top_p: float = None, key=None):
+                         top_p: float = None, key=None, eos_id: int = None):
     """Generation of ``max_new_tokens`` tokens from the TARGET model,
     accelerated by the draft. prompt: [1, S0] int32 →
     (tokens [1, max_new_tokens], stats dict with ``target_calls`` — the
@@ -112,7 +112,12 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     is consumed differently).
 
     ``spec_k``: draft tokens proposed per round. Each round emits between
-    1 and spec_k+1 tokens. Both models must share the vocabulary."""
+    1 and spec_k+1 tokens. Both models must share the vocabulary.
+
+    ``eos_id``: generate()'s finish semantics — every position after the
+    first emitted eos comes back as eos_id, and the loop STOPS speculating
+    once eos lands (plain decoding must scan to max_new_tokens; early
+    exit is a bonus speculation gets from its host-side while_loop)."""
     B, S0 = prompt.shape
     if B != 1:
         raise ValueError(
@@ -153,7 +158,13 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     out0 = out0.at[:, 0].set(tok0)
 
     def cond(carry):
-        return carry[1] < max_new_tokens
+        out, n = carry[0], carry[1]
+        go = n < max_new_tokens
+        if eos_id is not None:
+            # stop speculating once eos landed anywhere emitted so far
+            emitted = jnp.arange(out.shape[1]) < n
+            go = go & ~jnp.any(emitted & (out[0] == eos_id))
+        return go
 
     def body(carry):
         out, n, last, cache_t, cache_d, calls, key = carry
@@ -228,5 +239,21 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     out, n, _, _, _, calls, _ = lax.while_loop(
         cond, body, (out0, jnp.asarray(1, jnp.int32), tok0,
                      cache_t, cache_d, jnp.asarray(1, jnp.int32), key))
-    return out[:, :max_new_tokens], {"target_calls": calls,
-                                     "tokens": jnp.minimum(n, max_new_tokens)}
+    toks = out[:, :max_new_tokens]
+    n_tokens = jnp.minimum(n, max_new_tokens)
+    if eos_id is not None:
+        # HF unfinished_sequences convention (generate() parity): every
+        # position AFTER the first eos reads back as eos_id. This single
+        # mask also covers the last window's post-eos tail and any
+        # never-filled buffer slots from the early exit (both sit after
+        # the first eos).
+        is_eos = toks == eos_id
+        seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+        after = (seen - is_eos.astype(jnp.int32)) > 0
+        toks = jnp.where(after, eos_id, toks)
+        # finished length = through the first eos (n counts buffer writes,
+        # which include the final window's post-eos tail)
+        n_tokens = jnp.where(
+            jnp.any(is_eos),
+            jnp.argmax(is_eos[0]) + 1, n_tokens).astype(jnp.int32)
+    return toks, {"target_calls": calls, "tokens": n_tokens}

@@ -152,3 +152,23 @@ def test_speculative_swa_sinks_target():
     got, stats = speculative_generate(params, draft, prompt, cfg_t, CFG_D,
                                       max_new_tokens=16, spec_k=3)
     assert (got == want).all()
+
+
+def test_speculative_eos_matches_generate_and_early_exits():
+    """eos_id: emitted stream equals generate()'s finish semantics (every
+    position after the first eos reads eos_id) AND speculation stops
+    early — fewer target calls than the no-eos run."""
+    params, draft = _models(seed=7)
+    prompt = jax.random.randint(jax.random.key(13), (1, 16), 0, 128)
+    plain = generate(params, prompt, CFG_T, max_new_tokens=20, max_len=256)
+    eos = int(plain[0, 4])               # the 5th greedy token → early eos
+    want = generate(params, prompt, CFG_T, max_new_tokens=20, max_len=256,
+                    eos_id=eos)
+    got, stats = speculative_generate(params, draft, prompt, CFG_T, CFG_D,
+                                      max_new_tokens=20, spec_k=3,
+                                      eos_id=eos)
+    assert (got == want).all(), (got, want)
+    _, stats_noeos = speculative_generate(params, draft, prompt, CFG_T,
+                                          CFG_D, max_new_tokens=20,
+                                          spec_k=3)
+    assert int(stats["target_calls"]) < int(stats_noeos["target_calls"])
