@@ -96,7 +96,8 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
                          draft_cfg: LlamaConfig, *, max_new_tokens: int,
                          spec_k: int = 4, max_len: int = None,
                          temperature: float = 0.0, top_k: int = None,
-                         top_p: float = None, key=None, eos_id: int = None):
+                         top_p: float = None, key=None, eos_id: int = None,
+                         return_logprobs: bool = False):
     """Generation of ``max_new_tokens`` tokens from the TARGET model,
     accelerated by the draft. prompt: [1, S0] int32 →
     (tokens [1, max_new_tokens], stats dict with ``target_calls`` — the
@@ -117,7 +118,15 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     ``eos_id``: generate()'s finish semantics — every position after the
     first emitted eos comes back as eos_id, and the loop STOPS speculating
     once eos lands (plain decoding must scan to max_new_tokens; early
-    exit is a bonus speculation gets from its host-side while_loop)."""
+    exit is a bonus speculation gets from its host-side while_loop).
+
+    ``return_logprobs``: also return each emitted token's log-probability
+    under the TARGET's distribution at that position (greedy: unfiltered,
+    matching generate(); sampled: the filtered distribution the scheme
+    provably emits from — for a bonus token that is its marginal law's
+    source distribution, not the residual it was mechanically drawn from)
+    as a second [1, max_new_tokens] f32 array. Post-eos positions report
+    0.0, like generate()."""
     B, S0 = prompt.shape
     if B != 1:
         raise ValueError(
@@ -145,6 +154,14 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     # prefill both; the target's last-position logits give the first token
     logits_t, cache_t = prefill_t(params, prompt, cache_t)
     _, cache_d = prefill_d(draft_params, prompt, cache_d)
+    def emit_dist(logits):
+        """log of the distribution emitted tokens are reported under —
+        generate()'s convention: unfiltered for greedy, filtered for
+        sampling."""
+        if sampled:
+            logits = filter_logits(logits, temperature, top_k, top_p)
+        return jax.nn.log_softmax(logits, axis=-1)
+
     if sampled:
         key, k0 = jax.random.split(key)
         tok0 = jax.random.categorical(
@@ -156,9 +173,14 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     BUF = max_new_tokens + spec_k + 1          # slack for the last window
     out0 = jnp.zeros((1, BUF), jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
+    lp0 = jnp.zeros((1, BUF), jnp.float32)
+    if return_logprobs:
+        lp0 = lp0.at[:, 0].set(
+            jnp.take_along_axis(emit_dist(logits_t), tok0[:, None],
+                                axis=-1)[:, 0])
 
     def cond(carry):
-        out, n = carry[0], carry[1]
+        out, n = carry[0], carry[2]
         go = n < max_new_tokens
         if eos_id is not None:
             # stop speculating once eos landed anywhere emitted so far
@@ -167,7 +189,7 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         return go
 
     def body(carry):
-        out, n, last, cache_t, cache_d, calls, key = carry
+        out, lp, n, last, cache_t, cache_d, calls, key = carry
         key, kd, ka = jax.random.split(key, 3)
 
         # --- draft phase: k+1 serial cheap steps -----------------------
@@ -198,8 +220,8 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         calls = calls + 1
 
         if sampled:
-            p_t = jax.nn.softmax(
-                filter_logits(lg[0], temperature, top_k, top_p), axis=-1)
+            fl_t = filter_logits(lg[0], temperature, top_k, top_p)
+            p_t = jax.nn.softmax(fl_t, axis=-1)
             m, bonus = _spec_accept(ka, proposal[0],
                                     draft_probs[:spec_k], p_t)
             # emitted = accepted draft tokens then the bonus draw
@@ -224,6 +246,17 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         keep = jnp.arange(spec_k + 1)[None, :] < emit_n
         out = lax.dynamic_update_slice(
             out, jnp.where(keep, emit_vec, window), (0, n))
+        if return_logprobs:
+            # each emitted token scored under the target's distribution
+            # at its own position (lg[0, i] is the dist after prefix+d_<i);
+            # sampled mode reuses the already-filtered logits
+            ld = (jax.nn.log_softmax(fl_t, axis=-1) if sampled
+                  else jax.nn.log_softmax(lg[0], axis=-1))   # [k+1, V]
+            wlp = jnp.take_along_axis(ld, emit_vec[0][:, None],
+                                      axis=-1)[None, :, 0]   # [1, k+1]
+            lwin = lax.dynamic_slice(lp, (0, n), (1, spec_k + 1))
+            lp = lax.dynamic_update_slice(
+                lp, jnp.where(keep, wlp, lwin), (0, n))
 
         # --- rollback to the accepted state ----------------------------
         # target wrote k+1 entries ([last, d1..dk]); accepted needs
@@ -234,12 +267,14 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
             length=cache_t.length - (spec_k - m))
         cache_d = cache_d._replace(
             length=cache_d.length - (spec_k - m))
-        return out, n + emit_n, new_last, cache_t, cache_d, calls, key
+        return (out, lp, n + emit_n, new_last, cache_t, cache_d, calls,
+                key)
 
-    out, n, _, _, _, calls, _ = lax.while_loop(
-        cond, body, (out0, jnp.asarray(1, jnp.int32), tok0,
+    out, lp, n, _, _, _, calls, _ = lax.while_loop(
+        cond, body, (out0, lp0, jnp.asarray(1, jnp.int32), tok0,
                      cache_t, cache_d, jnp.asarray(1, jnp.int32), key))
     toks = out[:, :max_new_tokens]
+    lps = lp[:, :max_new_tokens]
     n_tokens = jnp.minimum(n, max_new_tokens)
     if eos_id is not None:
         # HF unfinished_sequences convention (generate() parity): every
@@ -251,9 +286,13 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
         after = (seen - is_eos.astype(jnp.int32)) > 0
         toks = jnp.where(after, eos_id, toks)
+        lps = jnp.where(after, 0.0, lps)     # forced eos: not a model draw
         # finished length = through the first eos (n counts buffer writes,
         # which include the final window's post-eos tail)
         n_tokens = jnp.where(
             jnp.any(is_eos),
             jnp.argmax(is_eos[0]) + 1, n_tokens).astype(jnp.int32)
-    return toks, {"target_calls": calls, "tokens": n_tokens}
+    stats = {"target_calls": calls, "tokens": n_tokens}
+    if return_logprobs:
+        return toks, lps, stats
+    return toks, stats

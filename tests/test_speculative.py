@@ -172,3 +172,56 @@ def test_speculative_eos_matches_generate_and_early_exits():
                                           CFG_D, max_new_tokens=20,
                                           spec_k=3)
     assert int(stats["target_calls"]) < int(stats_noeos["target_calls"])
+
+
+def test_speculative_logprobs_match_generate():
+    """Greedy logprobs under the target's unfiltered distribution — must
+    equal generate(return_logprobs=True)'s at every emitted position."""
+    import numpy as np
+
+    params, draft = _models(seed=8)
+    prompt = jax.random.randint(jax.random.key(14), (1, 16), 0, 128)
+    want_t, want_lp = generate(params, prompt, CFG_T, max_new_tokens=16,
+                               max_len=256, return_logprobs=True)
+    got_t, got_lp, stats = speculative_generate(
+        params, draft, prompt, CFG_T, CFG_D, max_new_tokens=16, spec_k=3,
+        return_logprobs=True)
+    assert (got_t == want_t).all()
+    np.testing.assert_allclose(np.asarray(got_lp), np.asarray(want_lp),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_speculative_logprobs_sampled_and_eos():
+    """Sampled-mode logprobs: finite, <= 0, consistent with the emitted
+    tokens' filtered target distribution (spot-checked at position 0,
+    which always comes from the prefill logits); post-eos positions
+    report exactly 0.0."""
+    import numpy as np
+
+    from gpu_provisioner_tpu.models.decode import (filter_logits, prefill,
+                                                   init_kv_cache)
+
+    params, draft = _models(seed=9)
+    prompt = jax.random.randint(jax.random.key(15), (1, 16), 0, 128)
+    kw = dict(max_new_tokens=12, spec_k=3, temperature=0.9, top_k=40,
+              key=jax.random.key(16), return_logprobs=True)
+    toks, lps, stats = speculative_generate(params, draft, prompt, CFG_T,
+                                            CFG_D, **kw)
+    assert np.isfinite(np.asarray(lps)).all() and (np.asarray(lps) <= 0).all()
+    # position 0: reported logprob must be the filtered prefill
+    # distribution's log-prob of the emitted token
+    logits0, _ = prefill(params, prompt, init_kv_cache(CFG_T, 1, 64),
+                         CFG_T, fresh=True)
+    ld0 = jax.nn.log_softmax(filter_logits(logits0, 0.9, 40, None), -1)
+    np.testing.assert_allclose(float(lps[0, 0]),
+                               float(ld0[0, toks[0, 0]]), atol=1e-4)
+
+    # eos zeroing: post-eos logprobs are exactly 0.0
+    plain = generate(params, prompt, CFG_T, max_new_tokens=12, max_len=256)
+    eos = int(plain[0, 2])
+    toks_e, lps_e, _ = speculative_generate(
+        params, draft, prompt, CFG_T, CFG_D, max_new_tokens=12, spec_k=3,
+        eos_id=eos, return_logprobs=True)
+    after = np.cumsum(np.asarray(toks_e[0]) == eos) > 1
+    first = int(np.argmax(np.asarray(toks_e[0]) == eos))
+    assert (np.asarray(lps_e[0])[first + 1:] == 0.0).all()
