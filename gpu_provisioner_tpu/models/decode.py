@@ -138,18 +138,29 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
 
     ``window`` (cfg.sliding_window): query p attends keys in
     (p − window, p] — both kernels bound their DMA to the window, so SWA
-    serving cost is O(window) per step regardless of cached history."""
+    serving cost is O(window) per step regardless of cached history.
+
+    ``start`` may be a scalar (all rows at the same length — the plain
+    serving loop) or a [B] vector (per-row lengths — batched speculative
+    decoding, where rows accept different numbers of draft tokens per
+    round). Vector start reaches the decode kernel via its per-row meta;
+    other kernel paths gate on scalar start and fall back to the dense
+    sweep, which masks per row."""
     B, S, Hq, Dh = q.shape
     Hkv, max_len = k_cache.shape[1], k_cache.shape[2]
-    if impl == "flash" and S == 1:
+    start = jnp.asarray(start)
+    if impl == "flash":
+        # short blocks (decode steps S=1, speculative verify S=spec_k+1,
+        # tiny continuations) take the decode/verify kernel: O(start+S)
+        # cache traffic instead of the dense sweep's O(max_len)
         from ..ops.flash_attention import (decode_flash_supported,
                                            flash_attention_decode)
-        if decode_flash_supported(max_len, Hq, Hkv):
+        if decode_flash_supported(max_len, Hq, Hkv, S=S):
             return flash_attention_decode(q, k_cache, v_cache, start,
                                           scale=scale, k_scale=k_scale,
                                           v_scale=v_scale, pad_lens=pad_lens,
                                           window=window, sinks=sinks)
-    if impl == "flash":
+    if impl == "flash" and start.ndim == 0:
         from ..ops.flash_attention import (cached_flash_supported,
                                            flash_attention_cached)
         if cached_flash_supported(S, max_len, Hq, Hkv):
@@ -167,26 +178,26 @@ def _cached_attention(q, k_cache, v_cache, start, scale, impl="dense",
     s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(jnp.float32),
                    kf) * scale
     key_pos = jnp.arange(max_len)                      # [K]
-    q_pos = start + jnp.arange(S)                      # [S]
-    mask = key_pos[None, :] <= q_pos[:, None]          # causal + written
+    # [B, S] query positions (scalar start broadcasts to every row)
+    q_pos = jnp.broadcast_to(jnp.reshape(start, (-1, 1))
+                             + jnp.arange(S), (B, S))
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]   # [B,S,K] causal
     if window is not None:
-        in_win = key_pos[None, :] > q_pos[:, None] - window   # [S, K]
+        in_win = key_pos[None, None, :] > q_pos[:, :, None] - window
         if sinks and pad_lens is None:
             # StreamingLLM: the first ``sinks`` keys stay attendable
-            in_win = in_win | (key_pos[None, :] < sinks)
+            in_win = in_win | (key_pos[None, None, :] < sinks)
         mask = mask & in_win
-    if pad_lens is None:
-        s = jnp.where(mask[None, None, None], s, NEG_INF)
-    else:
+    if pad_lens is not None:
         live = key_pos[None, None, :] >= pad_lens[:, None, None]  # [B, 1, K]
-        bmask = mask[None] & live                                 # [B, S, K]
+        mask = mask & live
         if window is not None and sinks:
             # per-row sinks: the first ``sinks`` REAL keys (after the pads)
             sink = (key_pos[None, None, :]
                     < pad_lens[:, None, None] + sinks)            # [B, 1, K]
-            causal_written = (key_pos[None, :] <= q_pos[:, None])[None]
-            bmask = bmask | (causal_written & live & sink)
-        s = jnp.where(bmask[:, None, None], s, NEG_INF)
+            causal_written = key_pos[None, None, :] <= q_pos[:, :, None]
+            mask = mask | (causal_written & live & sink)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)     # [B,1,1,S,K] bcast
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgqk,bhkd->bqhgd", p, vf)
     return o.reshape(B, S, Hq, Dh).astype(q.dtype)
@@ -206,18 +217,26 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
     PRECONDITION (caller-owned): ``cache.length + S <= max_len``. The write
     index is traced, so this cannot be checked here; past the bound,
     ``dynamic_update_slice`` clamps and silently corrupts the cache.
-    ``generate`` enforces it; manual decode loops must too."""
+    ``generate`` enforces it; manual decode loops must too.
+
+    ``cache.length`` may be a scalar (the plain serving loop) or a [B]
+    vector (per-row lengths — batched speculative decoding): writes then
+    land at each row's own offset and attention masks per row."""
     _resolve_attn(cfg.attn_impl, cfg.sliding_window,
                   cfg.attn_sinks)  # validate loudly — the dense fallback in
     # _cached_attention is shape-driven, not a typo escape hatch
     ad = cfg.act_dtype
     B, S = tokens.shape
     start = cache.length
-    positions = start + jnp.arange(S, dtype=jnp.int32)
+    per_row = jnp.ndim(start) == 1
+    positions = (jnp.reshape(start, (-1, 1)) if per_row else start) \
+        + jnp.arange(S, dtype=jnp.int32)
     if pad_lens is not None:
         # per-row REAL positions: pad rows clip to 0 (their k/v are masked
         # out of every attention, so their rope angle is irrelevant)
-        positions = jnp.maximum(positions[None, :] - pad_lens[:, None], 0)
+        if not per_row:
+            positions = positions[None, :]
+        positions = jnp.maximum(positions - pad_lens[:, None], 0)
     scale = cfg.head_dim ** -0.5
 
     x = params["embed"].astype(ad)[tokens]
@@ -231,8 +250,12 @@ def cached_forward(params: dict, tokens, cache: KVCache, cfg: LlamaConfig,
     def write(buf, new):
         # new tokens arrive token-major [B, S, ., Dh']; the head-major
         # transpose is O(S) — tiny next to the cache it writes into
-        return lax.dynamic_update_slice(
-            buf, new.transpose(0, 2, 1, 3), (0, 0, start, 0))
+        nh = new.transpose(0, 2, 1, 3)
+        if per_row:   # per-row offsets: a batched scatter via vmap
+            return jax.vmap(
+                lambda b, n, s: lax.dynamic_update_slice(b, n, (0, s, 0))
+            )(buf, nh, start)
+        return lax.dynamic_update_slice(buf, nh, (0, 0, start, 0))
 
     def body(carry, layer):
         h = carry
@@ -393,21 +416,27 @@ def prefill_chunked(params: dict, prompt, cache: KVCache, cfg: LlamaConfig,
     return logits[:, -1], cache
 
 
-def family_fns(cfg, pad_lens=None, fresh: bool = False):
+def family_fns(cfg, pad_lens=None, fresh: bool = False,
+               dropless_step: bool = False):
     """(prefill_fn, step_fn), each (params, tokens, cache) → (logits,
     cache), dispatched on the config's model family — THE dispatch point
     shared by generate() and speculative_generate so the two can never
     serve different code paths for the same config. ``fresh``: dense-only
     fast path for statically-empty caches (ignored for MoE, which has
     none). Pass fresh=False with pad_lens — the fast path cannot mask pad
-    keys and prefill raises; sliding_window is rerouted inside prefill."""
+    keys and prefill raises; sliding_window is rerouted inside prefill.
+    ``dropless_step``: MoE-only — step_fn routes with capacity = its block
+    width, so a multi-token step (speculative verify) cannot capacity-drop
+    and its logits equal sequential single-token decoding's (dense configs
+    have no cross-token FFN coupling; the flag is a no-op)."""
     from .moe import MoEConfig
     if isinstance(cfg, MoEConfig):
         from .moe_serve import moe_cached_forward, moe_prefill
         return (lambda p, t, c: moe_prefill(p, t, c, cfg,
                                             pad_lens=pad_lens),
                 lambda p, t, c: moe_cached_forward(p, t, c, cfg,
-                                                   pad_lens=pad_lens))
+                                                   pad_lens=pad_lens,
+                                                   dropless=dropless_step))
     return (lambda p, t, c: prefill(p, t, c, cfg, fresh=fresh,
                                     pad_lens=pad_lens),
             lambda p, t, c: cached_forward(p, t, c, cfg,

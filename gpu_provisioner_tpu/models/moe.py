@@ -116,13 +116,22 @@ def route(logits, k: int, cap: int, token_mask=None):
     return dispatch, combine
 
 
-def moe_ffn(x, lp: dict, cfg: MoEConfig, token_mask=None):
+def moe_ffn(x, lp: dict, cfg: MoEConfig, token_mask=None,
+            cap_override: int = None):
     """One MoE FFN layer. x: [B, S, D] → [B, S, D] (+ aux losses dict).
     ``token_mask`` [B, S]: see route() — masked tokens get zero output and
-    claim no expert capacity (serving's left-pad positions)."""
+    claim no expert capacity (serving's left-pad positions).
+
+    ``cap_override``: expert capacity to use instead of capacity(cfg, S).
+    ``cap_override=S`` makes the layer DROP-FREE (an expert can receive at
+    most S tokens — top-k picks k distinct experts per token), under which
+    each token's output is exactly its per-token routing Σ gateᵢ·expertᵢ(x)
+    — position-in-slot cancels in the combine sum. Speculative decoding's
+    verify block uses this for exact MoE-target equality with plain
+    per-token decode (models/speculative.py)."""
     B, S, D = x.shape
     ad = cfg.act_dtype
-    cap = capacity(cfg, S)
+    cap = cap_override if cap_override is not None else capacity(cfg, S)
     logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     dispatch, combine = route(logits, cfg.experts_per_token, cap,
                               token_mask=token_mask)

@@ -40,24 +40,34 @@ from .moe import MoEConfig, moe_ffn
 
 
 def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
-                       pad_lens=None):
+                       pad_lens=None, dropless: bool = False):
     """Forward over ``tokens`` [B, S] starting at cache.length; returns
     (logits [B, S, V], updated cache). The MoE twin of
     decode.cached_forward — same cache contract (caller guarantees
     cache.length + S <= max_len), same pad_lens semantics, params in
     init_moe_model's layout: {"backbone": ..., "moe": per-layer experts}.
+
+    ``dropless=True``: route with capacity = S so no token in this call can
+    be capacity-dropped, making an S-token block's logits exactly equal S
+    sequential single-token calls' (see moe_ffn). Speculative decoding's
+    verify block requires this; plain decode steps (S=1) are dropless
+    already, and prefill deliberately keeps training's capacity semantics.
     """
     _resolve_attn(cfg.attn_impl, cfg.sliding_window,
                   cfg.attn_sinks)  # loud validation
     ad = cfg.act_dtype
     B, S = tokens.shape
     start = cache.length
-    positions = start + jnp.arange(S, dtype=jnp.int32)
+    per_row = jnp.ndim(start) == 1    # per-row lengths (batched spec)
+    positions = (jnp.reshape(start, (-1, 1)) if per_row else start) \
+        + jnp.arange(S, dtype=jnp.int32)
     token_mask = None
     if pad_lens is not None:
         # cache position of token i is start+i; row b's pads fill [0, pad_b)
-        token_mask = positions[None, :] >= pad_lens[:, None]       # [B, S]
-        positions = jnp.maximum(positions[None, :] - pad_lens[:, None], 0)
+        if not per_row:
+            positions = positions[None, :]
+        token_mask = positions >= pad_lens[:, None]                # [B, S]
+        positions = jnp.maximum(positions - pad_lens[:, None], 0)
     scale = cfg.head_dim ** -0.5
 
     backbone = params["backbone"]
@@ -70,8 +80,12 @@ def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
             "int8 scales — cfg and init_kv_cache(cfg, ...) must agree")
 
     def write(buf, new):
-        return lax.dynamic_update_slice(
-            buf, new.transpose(0, 2, 1, 3), (0, 0, start, 0))
+        nh = new.transpose(0, 2, 1, 3)
+        if per_row:   # per-row offsets: a batched scatter via vmap
+            return jax.vmap(
+                lambda b, n, s: lax.dynamic_update_slice(b, n, (0, s, 0))
+            )(buf, nh, start)
+        return lax.dynamic_update_slice(buf, nh, (0, 0, start, 0))
 
     def body(carry, layer):
         h = carry
@@ -102,7 +116,8 @@ def moe_cached_forward(params: dict, tokens, cache: KVCache, cfg: MoEConfig,
         m = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
         # pad positions must not claim expert capacity (they sit FIRST in
         # the claim order and would evict real tokens) nor emit output
-        ffn_out, _aux = moe_ffn(m, lp_moe, cfg, token_mask=token_mask)
+        ffn_out, _aux = moe_ffn(m, lp_moe, cfg, token_mask=token_mask,
+                                cap_override=S if dropless else None)
         h = h + ffn_out
         out = ((k_cache, v_cache, k_scl, v_scl) if int8
                else (k_cache, v_cache))

@@ -32,22 +32,25 @@ exactly the target's filtered distribution (statistically verified in
 tests/test_speculative.py), though not token-identical to plain sampled
 generate for a given key (RNG consumption differs).
 
-Scope: batch 1 (speculation is a latency tool; per-row acceptance lengths
-would need per-row cache lengths). Both model families serve: dense and
+Batching: any B. Rows accept different numbers of draft tokens per round,
+so the loop carries PER-ROW cache lengths (``KVCache.length`` as a [B]
+vector — cached_forward writes at per-row offsets and the decode kernel
+takes per-row starts through its scalar-prefetch meta) and a per-row
+emit count; a finished row (quota or eos) rolls back everything its round
+wrote (m = −1) so its caches stop advancing while the batch runs on.
+Greedy batched speculation emits row-for-row exactly plain greedy
+generate()'s stream. Both model families serve: dense and
 MoE configs each dispatch to their own cached forward (draft and target
 independently — a dense draft speculating for an MoE target is the
-natural production pairing). Same vocabulary required. MoE-target caveat:
-the wide verify call routes its spec_k+1 tokens with the block's own
-capacity (competition WITHIN the block), while plain decode routes each
-token alone (dropless). Exactness for an MoE target therefore requires
-the verify block to be drop-free in the worst case — capacity(cfg,
-spec_k+1) ≥ spec_k+1, i.e. roughly capacity_factor · experts_per_token
-≥ n_experts. Mixtral-style cf≈1.25 · 2 < 8 does NOT satisfy it: if
-several verify-block tokens pick the same expert, a drop makes the
-verify logits diverge from plain per-token decoding and speculative
-output can differ from plain greedy. Raise capacity_factor for serving
-(capacity is a training-efficiency device) or accept approximate
-equality. Dense targets have no such coupling.
+natural production pairing). Same vocabulary required. MoE targets: the
+wide verify call routes with a DROP-FREE capacity override (capacity =
+spec_k+1 for its own block — family_fns(dropless_step=True)), so no
+verify token can be capacity-dropped and the verify logits equal plain
+per-token decoding's exactly, even at Mixtral-style capacity factors
+(cf≈1.25 · k=2 < E=8) where the training capacity WOULD drop. The
+override exists because capacity is a training-efficiency device, not a
+sampling semantic; the extra verify FLOPs are O(spec_k²/E) expert slots —
+noise. Dense targets have no cross-token FFN coupling to begin with.
 
 Reference parity note: workload-side scope beyond the reference
 (SURVEY.md §2c) — the serving stack KAITO provisions for.
@@ -97,12 +100,12 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
                          spec_k: int = 4, max_len: int = None,
                          temperature: float = 0.0, top_k: int = None,
                          top_p: float = None, key=None, eos_id: int = None,
-                         return_logprobs: bool = False):
+                         pad_id: int = None, return_logprobs: bool = False):
     """Generation of ``max_new_tokens`` tokens from the TARGET model,
-    accelerated by the draft. prompt: [1, S0] int32 →
-    (tokens [1, max_new_tokens], stats dict with ``target_calls`` — the
-    number of target forwards actually executed, vs max_new_tokens for
-    plain decoding).
+    accelerated by the draft. prompt: [B, S0] int32 →
+    (tokens [B, max_new_tokens], stats dict with ``target_calls`` — the
+    number of wide target forwards actually executed (rounds), vs
+    max_new_tokens for plain decoding, and per-row ``tokens``).
 
     ``temperature`` 0 (default) = greedy: output is EXACTLY plain greedy's
     stream. ``temperature`` > 0 (``key`` REQUIRED, same rule as generate):
@@ -116,22 +119,24 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
     1 and spec_k+1 tokens. Both models must share the vocabulary.
 
     ``eos_id``: generate()'s finish semantics — every position after the
-    first emitted eos comes back as eos_id, and the loop STOPS speculating
-    once eos lands (plain decoding must scan to max_new_tokens; early
-    exit is a bonus speculation gets from its host-side while_loop).
+    first emitted eos comes back as eos_id, and a finished ROW stops
+    contributing draft/verify work (its round rolls back in full); the
+    loop exits once every row is finished (plain decoding must scan to
+    max_new_tokens; early exit is a bonus speculation gets from its
+    host-side while_loop).
+
+    ``pad_id``: generate()'s ragged-batch convention — LEFT-pad prompts
+    to a common S0; pad keys are masked out of every attention and RoPE
+    counts from each row's first real token.
 
     ``return_logprobs``: also return each emitted token's log-probability
     under the TARGET's distribution at that position (greedy: unfiltered,
     matching generate(); sampled: the filtered distribution the scheme
     provably emits from — for a bonus token that is its marginal law's
     source distribution, not the residual it was mechanically drawn from)
-    as a second [1, max_new_tokens] f32 array. Post-eos positions report
+    as a second [B, max_new_tokens] f32 array. Post-eos positions report
     0.0, like generate()."""
     B, S0 = prompt.shape
-    if B != 1:
-        raise ValueError(
-            f"speculative decoding is batch-1 (latency tool); got B={B} — "
-            "per-row acceptance would need per-row cache lengths")
     if spec_k < 1:
         raise ValueError(f"spec_k must be >= 1, got {spec_k}")
     if cfg.vocab_size != draft_cfg.vocab_size:
@@ -143,17 +148,37 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         key = jax.random.key(0)          # threaded but never consumed
     if max_len is None:
         max_len = S0 + max_new_tokens + spec_k + 1
-    # the verify call may run up to spec_k+1 past the final emission
-    assert S0 + max_new_tokens + spec_k + 1 <= max_len, (
-        S0, max_new_tokens, spec_k, max_len)
+    # the verify call may run up to spec_k+1 past the final emission;
+    # ValueError (not assert — stripped under -O) because violation
+    # silently corrupts the cache via dynamic_update_slice clamping
+    if S0 + max_new_tokens + spec_k + 1 > max_len:
+        raise ValueError(
+            f"max_len={max_len} cannot hold prompt ({S0}) + "
+            f"max_new_tokens ({max_new_tokens}) + verify slack "
+            f"(spec_k+1 = {spec_k + 1})")
 
-    prefill_t, step_t = family_fns(cfg, fresh=True)
-    prefill_d, step_d = family_fns(draft_cfg, fresh=True)
-    cache_t = init_kv_cache(cfg, 1, max_len)
-    cache_d = init_kv_cache(draft_cfg, 1, max_len)
+    pad_lens = None
+    if pad_id is not None:
+        # leading-pad count per row == index of the first real token
+        pad_lens = jnp.argmax((prompt != pad_id).astype(jnp.int32),
+                              axis=1).astype(jnp.int32)
+
+    # dropless_step: the verify block must not capacity-drop (MoE targets)
+    # — see the module docstring; no-op for dense configs
+    prefill_t, step_t = family_fns(cfg, pad_lens=pad_lens,
+                                   fresh=pad_id is None, dropless_step=True)
+    prefill_d, step_d = family_fns(draft_cfg, pad_lens=pad_lens,
+                                   fresh=pad_id is None)
+    cache_t = init_kv_cache(cfg, B, max_len)
+    cache_d = init_kv_cache(draft_cfg, B, max_len)
     # prefill both; the target's last-position logits give the first token
     logits_t, cache_t = prefill_t(params, prompt, cache_t)
     _, cache_d = prefill_d(draft_params, prompt, cache_d)
+    # per-row cache lengths from here on: rows accept different numbers of
+    # draft tokens per round, so their caches advance at different rates
+    row_len = jnp.full((B,), S0, jnp.int32)
+    cache_t = cache_t._replace(length=row_len)
+    cache_d = cache_d._replace(length=row_len)
     def emit_dist(logits):
         """log of the distribution emitted tokens are reported under —
         generate()'s convention: unfiltered for greedy, filtered for
@@ -171,26 +196,41 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
 
     BUF = max_new_tokens + spec_k + 1          # slack for the last window
-    out0 = jnp.zeros((1, BUF), jnp.int32)
+    out0 = jnp.zeros((B, BUF), jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
-    lp0 = jnp.zeros((1, BUF), jnp.float32)
+    lp0 = jnp.zeros((B, BUF), jnp.float32)
     if return_logprobs:
         lp0 = lp0.at[:, 0].set(
             jnp.take_along_axis(emit_dist(logits_t), tok0[:, None],
                                 axis=-1)[:, 0])
+    n0 = jnp.ones((B,), jnp.int32)
+    done0 = n0 >= max_new_tokens
+    if eos_id is not None:
+        done0 = done0 | (tok0 == eos_id)
 
     def cond(carry):
-        out, n = carry[0], carry[2]
-        go = n < max_new_tokens
-        if eos_id is not None:
-            # stop speculating once eos landed anywhere emitted so far
-            emitted = jnp.arange(out.shape[1]) < n
-            go = go & ~jnp.any(emitted & (out[0] == eos_id))
-        return go
+        return jnp.any(~carry[4])              # any row still generating
 
     def body(carry):
-        out, lp, n, last, cache_t, cache_d, calls, key = carry
+        out, lp, n, last, done, cache_t, cache_d, calls, key = carry
         key, kd, ka = jax.random.split(key, 3)
+
+        # A FINISHED row still flows through the round's k+1 writes (static
+        # shapes), and its frozen length can sit as high as
+        # S0+max_new+spec_k — writing k+1 entries there would escape
+        # max_len (dynamic_update_slice would clamp and silently overwrite
+        # the live tail). Clamp finished rows' write offset into bounds:
+        # everything a finished row writes is discarded (it is never
+        # queried again and the caches are not returned), so parking its
+        # writes at the bound keeps cached_forward's precondition intact
+        # for every row. Active rows are in-bounds by the max_len guard.
+        safe = jnp.minimum(cache_t.length, max_len - (spec_k + 1))
+        cache_t = cache_t._replace(
+            length=jnp.where(done, safe, cache_t.length))
+        cache_d = cache_d._replace(
+            length=jnp.where(done, jnp.minimum(cache_d.length,
+                                               max_len - (spec_k + 1)),
+                             cache_d.length))
 
         # --- draft phase: k+1 serial cheap steps -----------------------
         # step i consumes token i of [last, d1..dk]; the (k+1)-th write
@@ -198,80 +238,91 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         # leaves the draft consistent without a special case
         def draft_scan(c, kt):
             cache_d, tok = c
-            lg, cache_d = step_d(draft_params, tok[None], cache_d)
+            lg, cache_d = step_d(draft_params, tok[:, None], cache_d)
             if sampled:
                 fl = filter_logits(lg[:, 0], temperature, top_k, top_p)
-                probs = jax.nn.softmax(fl, axis=-1)[0]          # [V]
+                probs = jax.nn.softmax(fl, axis=-1)             # [B, V]
                 nxt = jax.random.categorical(kt, fl,
                                              axis=-1).astype(jnp.int32)
             else:
-                probs = jnp.zeros((draft_cfg.vocab_size,))      # unused
+                probs = jnp.zeros((B, draft_cfg.vocab_size))    # unused
                 nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
             return (cache_d, nxt), (nxt, probs)
 
         (cache_d, _), (drafts, draft_probs) = lax.scan(
             draft_scan, (cache_d, last), jax.random.split(kd, spec_k + 1))
-        drafts = drafts.transpose(1, 0)                 # [1, k+1]
+        drafts = drafts.transpose(1, 0)                 # [B, k+1]
         proposal = drafts[:, :spec_k]                   # d_1..d_k
 
         # --- target phase: ONE wide verify call ------------------------
         block = jnp.concatenate([last[:, None], proposal], axis=1)
-        lg, cache_t = step_t(params, block, cache_t)
+        lg, cache_t = step_t(params, block, cache_t)    # [B, k+1, V]
         calls = calls + 1
 
         if sampled:
-            fl_t = filter_logits(lg[0], temperature, top_k, top_p)
-            p_t = jax.nn.softmax(fl_t, axis=-1)
-            m, bonus = _spec_accept(ka, proposal[0],
-                                    draft_probs[:spec_k], p_t)
+            fl_t = filter_logits(lg, temperature, top_k, top_p)
+            p_t = jax.nn.softmax(fl_t, axis=-1)         # [B, k+1, V]
+            dp = draft_probs.transpose(1, 0, 2)[:, :spec_k]  # [B, k, V]
+            m, bonus = jax.vmap(_spec_accept)(
+                jax.random.split(ka, B), proposal, dp, p_t)  # [B], [B]
             # emitted = accepted draft tokens then the bonus draw
             prop_pad = jnp.concatenate(
-                [proposal[0], jnp.zeros((1,), jnp.int32)])
-            emit_vec = jnp.where(jnp.arange(spec_k + 1) < m,
-                                 prop_pad, bonus)[None, :]
-            new_last = jnp.full((1,), bonus, jnp.int32)
+                [proposal, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            emit_vec = jnp.where(jnp.arange(spec_k + 1)[None] < m[:, None],
+                                 prop_pad, bonus[:, None])   # [B, k+1]
+            new_last = bonus
         else:
-            preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [1, k+1]
+            preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [B, k+1]
             # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
             agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)[0]
+            m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
             # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}
             emit_vec = preds
-            new_last = preds[jnp.arange(1), m]                  # p_{m+1}
-        emit_n = m + 1                                          # + bonus
+            new_last = preds[jnp.arange(B), m]                  # p_{m+1}
+        # finished rows emit NOTHING this round (m = −1 ⇒ emit_n = 0 and
+        # the rollback below drops every entry the round wrote)
+        m = jnp.where(done, -1, m)
+        emit_n = m + 1                                          # [B]
+        new_last = jnp.where(done, last, new_last)
 
-        # write the full fixed window, masked so positions ≥ emit_n keep
-        # their old buffer contents
-        window = lax.dynamic_slice(out, (0, n), (1, spec_k + 1))
-        keep = jnp.arange(spec_k + 1)[None, :] < emit_n
-        out = lax.dynamic_update_slice(
-            out, jnp.where(keep, emit_vec, window), (0, n))
+        # write the full fixed window PER ROW at its own offset, masked so
+        # positions ≥ emit_n keep their old buffer contents
+        keep = jnp.arange(spec_k + 1)[None] < emit_n[:, None]   # [B, k+1]
+
+        def row_update(buf_row, n_b, new_b, keep_b):
+            window = lax.dynamic_slice(buf_row, (n_b,), (spec_k + 1,))
+            return lax.dynamic_update_slice(
+                buf_row, jnp.where(keep_b, new_b, window), (n_b,))
+
+        out = jax.vmap(row_update)(out, n, emit_vec, keep)
         if return_logprobs:
             # each emitted token scored under the target's distribution
-            # at its own position (lg[0, i] is the dist after prefix+d_<i);
+            # at its own position (lg[b, i] is the dist after prefix+d_<i);
             # sampled mode reuses the already-filtered logits
             ld = (jax.nn.log_softmax(fl_t, axis=-1) if sampled
-                  else jax.nn.log_softmax(lg[0], axis=-1))   # [k+1, V]
-            wlp = jnp.take_along_axis(ld, emit_vec[0][:, None],
-                                      axis=-1)[None, :, 0]   # [1, k+1]
-            lwin = lax.dynamic_slice(lp, (0, n), (1, spec_k + 1))
-            lp = lax.dynamic_update_slice(
-                lp, jnp.where(keep, wlp, lwin), (0, n))
+                  else jax.nn.log_softmax(lg, axis=-1))   # [B, k+1, V]
+            wlp = jnp.take_along_axis(ld, emit_vec[..., None],
+                                      axis=-1)[..., 0]    # [B, k+1]
+            lp = jax.vmap(row_update)(lp, n, wlp, keep)
 
         # --- rollback to the accepted state ----------------------------
-        # target wrote k+1 entries ([last, d1..dk]); accepted needs
-        # [.., last, d1..dm] → drop (k - m). draft wrote k+1 entries
-        # ([last, d1..dk]) and the next round feeds new_last, so it also
-        # keeps [.., last, d1..dm] → drop (k - m).
+        # target wrote k+1 entries ([last, d1..dk]) at each row's offset;
+        # accepted needs [.., last, d1..dm] → drop (k - m). draft wrote
+        # k+1 entries and the next round feeds new_last, so it also keeps
+        # [.., last, d1..dm] → drop (k - m). (done rows: m = −1 drops all
+        # k+1 — their caches never advance.)
         cache_t = cache_t._replace(
             length=cache_t.length - (spec_k - m))
         cache_d = cache_d._replace(
             length=cache_d.length - (spec_k - m))
-        return (out, lp, n + emit_n, new_last, cache_t, cache_d, calls,
-                key)
+        n = n + emit_n
+        done = done | (n >= max_new_tokens)
+        if eos_id is not None:
+            done = done | jnp.any(keep & (emit_vec == eos_id), axis=1)
+        return (out, lp, n, new_last, done, cache_t, cache_d, calls, key)
 
-    out, lp, n, _, _, _, calls, _ = lax.while_loop(
-        cond, body, (out0, lp0, jnp.asarray(1, jnp.int32), tok0,
+    out, lp, n, _, _, _, _, calls, _ = lax.while_loop(
+        cond, body, (out0, lp0, n0, tok0, done0,
                      cache_t, cache_d, jnp.asarray(1, jnp.int32), key))
     toks = out[:, :max_new_tokens]
     lps = lp[:, :max_new_tokens]
@@ -290,8 +341,8 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
         # finished length = through the first eos (n counts buffer writes,
         # which include the final window's post-eos tail)
         n_tokens = jnp.where(
-            jnp.any(is_eos),
-            jnp.argmax(is_eos[0]) + 1, n_tokens).astype(jnp.int32)
+            jnp.any(is_eos, axis=1),
+            jnp.argmax(is_eos, axis=1) + 1, n_tokens).astype(jnp.int32)
     stats = {"target_calls": calls, "tokens": n_tokens}
     if return_logprobs:
         return toks, lps, stats

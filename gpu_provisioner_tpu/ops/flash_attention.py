@@ -658,29 +658,37 @@ def flash_attention_cached(q, k_cache, v_cache, start, *, scale: float = None,
 # --- KV-cache decode step (S = 1) ------------------------------------------
 
 def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
-                   scale, int8, padded, window=None, sinks=0):
-    """One generated token's attention against the cache: grid row bh owns
-    kv head ``bh % Hkv`` of batch ``bh // Hkv`` and computes ALL ``group``
-    of its GQA queries in one pass — the cache tile is fetched once per kv
-    head (the dense sweep and a per-q-head grid both read it group× more).
-    ``meta_ref`` (SMEM scalar prefetch): [start, pad_len_0..pad_len_B-1];
-    every query sits at position ``start``, so the mask is row-uniform:
-    pad_len ≤ key position ≤ start. Blocks outside that window are neither
-    computed (the ``live`` gate) nor fetched (the clamped index map)."""
+                   scale, int8, padded, n_start=1, S=1, window=None,
+                   sinks=0):
+    """A SHORT query block's attention against the cache: grid row bh owns
+    kv head ``bh % Hkv`` of batch ``bh // Hkv`` and computes all ``S``
+    query positions × ``group`` GQA queries of that head in one pass — the
+    cache tile is fetched once per kv head (the dense sweep and a
+    per-q-head grid both read it group× more). ``S`` is 1 for a decode
+    step; speculative verify blocks and short continuations use S>1 (query
+    i sits at cache position start_b+i, so the causal bound is per query
+    row). ``meta_ref`` (SMEM scalar prefetch):
+    [start_0..start_{n_start-1}, pad_len_0..pad_len_{B-1}]; ``n_start`` is
+    1 (every row at the same ``start`` — the plain serving loop) or B
+    (per-row lengths — batched speculative decoding). The mask per q-row:
+    pad_len ≤ key position ≤ start_b + s_row. Blocks outside every row's
+    window are neither computed (the ``live`` gate) nor fetched (the
+    clamped index map)."""
     if int8:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
     kj = pl.program_id(1)
     n_kv = pl.num_programs(1)
-    start = meta_ref[0]
-    pad = meta_ref[1 + pl.program_id(0) // Hkv] if padded else 0
+    b = pl.program_id(0) // Hkv
+    start = meta_ref[b] if n_start > 1 else meta_ref[0]
+    pad = meta_ref[n_start + b] if padded else 0
 
     @pl.when(kj == 0)
     def _init():
         _init_softmax_scratch(acc_ref, m_ref, l_ref)
 
-    live = kj * block_k <= start
+    live = kj * block_k <= start + (S - 1)    # any query row reaches it
     if padded:
         live = live & ((kj + 1) * block_k - 1 >= pad)
     if window is not None:
@@ -697,17 +705,20 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
         else:
             k = k_ref[0].astype(jnp.float32)
             v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)              # [group, D]
+        q = q_ref[0].astype(jnp.float32)              # [S·group, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [group, BK]
+            preferred_element_type=jnp.float32) * scale   # [S·group, BK]
         kv_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        mask = kv_pos <= start
+        # query row r is position start + r // group (row-major (s, g))
+        q_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (S * group, 1), 0) // group
+        mask = kv_pos <= q_pos
         if padded:
             mask = mask & (kv_pos >= pad)
         if window is not None:
-            wkeep = kv_pos > start - window
+            wkeep = kv_pos > q_pos - window
             if sinks:
                 wkeep = wkeep | (kv_pos < pad + sinks)
             mask = mask & wkeep
@@ -718,22 +729,32 @@ def _kernel_decode(meta_ref, q_ref, k_ref, v_ref, *rest, Hkv, group, block_k,
         _finalize_out(o_ref, acc_ref, m_ref, l_ref)
 
 
+DECODE_MAX_S = 16   # short-block bound: verify blocks / tiny continuations
+
+
 def decode_flash_supported(max_len: int, Hq: int, Hkv: int,
-                           block_k: int = None) -> bool:
+                           block_k: int = None, S: int = 1) -> bool:
     """True iff flash_attention_decode can take these shapes (max_len tiles
-    into ≥128-aligned kv blocks, GQA divides)."""
+    into ≥128-aligned kv blocks, GQA divides, query block short)."""
     bk = _auto_block(max_len, block_k)
-    return max_len % bk == 0 and bk >= 128 and Hq % Hkv == 0
+    return (max_len % bk == 0 and bk >= 128 and Hq % Hkv == 0
+            and 1 <= S <= DECODE_MAX_S)
 
 
 def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
                            block_k: int = None, interpret: bool = None,
                            k_scale=None, v_scale=None, pad_lens=None,
                            window: int = None, sinks: int = 0):
-    """The serving decode step as a Pallas kernel: ONE new token per row
-    ([B, 1, Hq, D] queries at cache position ``start``) against a
-    [B, Hkv, max_len, D] head-major cache (forward-only; decode never
-    differentiates).
+    """The serving decode/verify step as a Pallas kernel: a SHORT query
+    block per row ([B, S, Hq, D], S ≤ DECODE_MAX_S — S=1 for a decode
+    step, S=spec_k+1 for a speculative verify block, small S for short
+    continuations) at cache positions ``start..start+S−1`` against a
+    [B, Hkv, max_len, D] head-major cache (forward-only; serving never
+    differentiates). The whole block shares ONE fetch of the live cache
+    prefix per kv head, so a verify call costs O(start+S) HBM traffic
+    instead of the dense sweep's O(max_len) — the same economics that
+    make the S=1 step cheap, extended to the block widths speculation
+    uses.
 
     Replaces models/decode.py:_cached_attention's S=1 dense sweep, which
     XLA must compute over the FULL static max_len width because ``start``
@@ -751,9 +772,14 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
     all-pad blocks are likewise skipped and un-fetched. ``window``:
     sliding-window attention — keys in (start − window, start]; a
     long-context SWA decode step fetches O(window), independent of how
-    much history is cached. Callers gate on decode_flash_supported()."""
+    much history is cached. ``start`` may be scalar or [B] (per-row cache
+    lengths — batched speculative decoding); per-row starts ride the same
+    scalar-prefetch meta as pads, so each row's DMA still stops at its own
+    live prefix. Callers gate on decode_flash_supported()."""
     B, S, Hq, D = q.shape
-    assert S == 1, f"decode kernel is single-token; got S={S}"
+    assert 1 <= S <= DECODE_MAX_S, \
+        f"decode kernel serves short query blocks (S<={DECODE_MAX_S}); " \
+        f"got S={S}"
     Hkv, ML = k_cache.shape[1], k_cache.shape[2]
     group = Hq // Hkv
     if scale is None:
@@ -763,22 +789,28 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
         interpret = jax.default_backend() not in ("tpu", "axon")
 
     # head h = (h // group)-th kv head, (h % group)-th query of its group —
-    # the same grouping _cached_attention's reshape uses
-    qf = q.reshape(B * Hkv, group, D)
+    # the same grouping _cached_attention's reshape uses; kernel rows are
+    # (s, g) row-major so row // group recovers the query position
+    qf = q.reshape(B, S, Hkv, group, D).transpose(0, 2, 1, 3, 4) \
+        .reshape(B * Hkv, S * group, D)
     kf = k_cache.reshape(B * Hkv, ML, D)
     vf = v_cache.reshape(B * Hkv, ML, D)
     padded = pad_lens is not None
-    meta = jnp.asarray(start, jnp.int32).reshape(1)
+    starts = jnp.asarray(start, jnp.int32).reshape(-1)   # [1] or [B]
+    n_start = starts.shape[0]
+    assert n_start in (1, B), f"start must be scalar or [B]; got {n_start}"
+    meta = starts
     if padded:
         meta = jnp.concatenate([meta, pad_lens.astype(jnp.int32)])
 
     def kv_idx(bh, kj, meta_ref):
-        pad = meta_ref[1 + bh // Hkv] if padded else 0
+        st = meta_ref[bh // Hkv] if n_start > 1 else meta_ref[0]
+        pad = meta_ref[n_start + bh // Hkv] if padded else 0
         lo_pos = pad
         if window is not None:
             lo_pos = jnp.maximum(lo_pos,
-                                 jnp.maximum(meta_ref[0] - window + 1, 0))
-        hi = meta_ref[0] // block_k
+                                 jnp.maximum(st - window + 1, 0))
+        hi = (st + S - 1) // block_k       # the LAST query row's frontier
         if window is not None and sinks:
             # sink blocks walk at identity; the dead middle clamps forward
             # to the window's first block (repeats → single fetch)
@@ -790,8 +822,9 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
         return (bh, jnp.clip(kj, lo_pos // block_k, hi), 0)
 
     q_idx = lambda bh, kj, meta_ref: (bh, 0, 0)
+    rows = S * group
     in_specs = [
-        pl.BlockSpec((1, group, D), q_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, rows, D), q_idx, memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_k, D), kv_idx, memory_space=pltpu.VMEM),
     ]
@@ -808,23 +841,25 @@ def flash_attention_decode(q, k_cache, v_cache, start, *, scale: float = None,
         num_scalar_prefetch=1,
         grid=(B * Hkv, ML // block_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, group, D), q_idx,
+        out_specs=pl.BlockSpec((1, rows, D), q_idx,
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((group, D), jnp.float32),     # acc
-            pltpu.VMEM((group, 1), jnp.float32),     # running max
-            pltpu.VMEM((group, 1), jnp.float32),     # running denominator
+            pltpu.VMEM((rows, D), jnp.float32),     # acc
+            pltpu.VMEM((rows, 1), jnp.float32),     # running max
+            pltpu.VMEM((rows, 1), jnp.float32),     # running denominator
         ],
     )
     out = pl.pallas_call(
         functools.partial(_kernel_decode, Hkv=Hkv, group=group,
                           block_k=block_k, scale=scale, int8=int8,
-                          padded=padded, window=window, sinks=sinks),
+                          padded=padded, n_start=n_start, S=S,
+                          window=window, sinks=sinks),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * Hkv, group, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, rows, D), q.dtype),
         interpret=interpret,
     )(meta, *operands)
-    return out.reshape(B, 1, Hq, D)
+    return out.reshape(B, Hkv, S, group, D).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, S, Hq, D)
 
 
 # --- backward kernels (FlashAttention-2 §3.2: per-block recompute) ---------

@@ -295,6 +295,107 @@ def test_decode_flash_under_jit_traced_start():
                                    np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_decode_flash_per_row_starts_match_rowwise_dense():
+    """Vector ``start`` (per-row cache lengths — batched speculative
+    decoding): both the decode kernel and the dense sweep must equal each
+    row computed ALONE at its own scalar start."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 3, 256, 4, 2, 32
+    ks = jax.random.split(jax.random.key(31), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    starts = jnp.asarray([37, 0, 255], jnp.int32)
+    want = jnp.concatenate([
+        _cached_attention(q[b:b + 1], kc[b:b + 1], vc[b:b + 1],
+                          starts[b], scale) for b in range(B)])
+    for got in (flash_attention_decode(q, kc, vc, starts, scale=scale),
+                _cached_attention(q, kc, vc, starts, scale)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+    # traced vector start under jit (the batched speculative loop's shape)
+    f = jax.jit(lambda s: flash_attention_decode(q, kc, vc, s, scale=scale))
+    np.testing.assert_allclose(np.asarray(f(starts)), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_short_blocks_match_dense():
+    """S>1 short query blocks (speculative verify / tiny continuations)
+    through the decode/verify kernel: every query row gets its own causal
+    frontier (position start+i) — must match the dense sweep across S,
+    GQA widths, pads, window/sinks, int8, and per-row starts."""
+    from gpu_provisioner_tpu.models.decode import (_cached_attention,
+                                                   _quantize_kv)
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hkv, D = 2, 256, 2, 32
+    ks = jax.random.split(jax.random.key(33), 3)
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    for S, group in ((3, 2), (5, 1), (16, 4)):
+        Hq = Hkv * group
+        q = jax.random.normal(ks[0], (B, S, Hq, D))
+        s = jnp.asarray(100, jnp.int32)
+        out = flash_attention_decode(q, kc, vc, s, scale=scale)
+        ref = _cached_attention(q, kc, vc, s, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"S={S} group={group}")
+    # pads + window + sinks + per-row starts, S=4
+    q = jax.random.normal(ks[0], (B, 4, 4, D))
+    pads = jnp.asarray([0, 11], jnp.int32)
+    starts = jnp.asarray([130, 40], jnp.int32)
+    out = flash_attention_decode(q, kc, vc, starts, scale=scale,
+                                 pad_lens=pads, window=64, sinks=2)
+    ref = _cached_attention(q, kc, vc, starts, scale, pad_lens=pads,
+                            window=64, sinks=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # int8 cache mode, S=3
+    k_tm = jax.random.normal(ks[1], (B, ML, Hkv, D))
+    v_tm = jax.random.normal(ks[2], (B, ML, Hkv, D))
+    kq, kscl = _quantize_kv(k_tm)
+    vq, vscl = _quantize_kv(v_tm)
+    hm = lambda x: x.transpose(0, 2, 1, 3)
+    q3 = jax.random.normal(ks[0], (B, 3, 4, D))
+    s = jnp.asarray(77, jnp.int32)
+    out = flash_attention_decode(q3, hm(kq), hm(vq), s, scale=scale,
+                                 k_scale=hm(kscl), v_scale=hm(vscl))
+    ref = _cached_attention(q3, hm(kq), hm(vq), s, scale,
+                            k_scale=hm(kscl), v_scale=hm(vscl))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_flash_per_row_starts_with_pads():
+    """Per-row starts compose with per-row left-pads (ragged batched
+    speculation): row b attends keys in [pad_b, start_b]."""
+    from gpu_provisioner_tpu.models.decode import _cached_attention
+    from gpu_provisioner_tpu.ops.flash_attention import flash_attention_decode
+
+    B, ML, Hq, Hkv, D = 2, 256, 4, 2, 32
+    ks = jax.random.split(jax.random.key(32), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, ML, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, ML, D))
+    scale = D ** -0.5
+    starts = jnp.asarray([130, 40], jnp.int32)
+    pads = jnp.asarray([0, 17], jnp.int32)
+    want = jnp.concatenate([
+        _cached_attention(q[b:b + 1], kc[b:b + 1], vc[b:b + 1], starts[b],
+                          scale, pad_lens=pads[b:b + 1])
+        for b in range(B)])
+    for got in (flash_attention_decode(q, kc, vc, starts, scale=scale,
+                                       pad_lens=pads),
+                _cached_attention(q, kc, vc, starts, scale, pad_lens=pads)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+
 def test_cached_flash_padded_matches_dense_on_real_rows():
     """pad_lens in the PREFILL kernel: key positions below each row's pad
     length are masked and leading all-pad blocks un-fetched. Pad-QUERY
