@@ -10,6 +10,11 @@ cannot run pp>1. These specs close the gap the moment a pod is attached:
 
 They skip everywhere else (including the normal CPU-forced suite), so the
 file rides CI green as a staged contract, not dead weight.
+
+Only GENUINELY multi-chip specs live here (VERDICT r4 item 7): the
+single-chip kernel-lowering pass and the triangular-grid sign-off moved to
+hack/tpu_onchip_checks.py (run_lowering_checks), which runs in any live
+single-chip window rather than waiting for a pod.
 """
 
 import os
@@ -108,53 +113,3 @@ def test_bf16_zigzag_ring_attention_on_pod():
         np.asarray(ref.astype(jnp.float32)), atol=5e-2, rtol=5e-2)
 
 
-def test_flash_kernels_lower_on_chip():
-    """One real-TPU lowering pass over every Pallas kernel variant
-    (resident, streaming, cached, backward) — interpret mode cannot catch
-    lowering errors (the repo's documented tiling gotcha)."""
-    import jax
-    import jax.numpy as jnp
-
-    from gpu_provisioner_tpu.ops.flash_attention import (
-        flash_attention, flash_attention_cached)
-
-    ks = jax.random.split(jax.random.key(0), 3)
-    q, k, v = (jax.random.normal(kk, (1, 1024, 4, 128), jnp.bfloat16)
-               for kk in ks)
-    out = flash_attention(q, k, v)                       # resident fwd
-    g = jax.grad(lambda *a: jnp.sum(flash_attention(*a)
-                                    .astype(jnp.float32) ** 2))(q, k, v)
-    g_tri = jax.grad(lambda *a: jnp.sum(                 # triangular bwd
-        flash_attention(*a, triangular=True).astype(jnp.float32) ** 2))(
-        q, k, v)
-    kc = jax.random.normal(ks[1], (1, 2, 2048, 128), jnp.bfloat16)
-    vc = jax.random.normal(ks[2], (1, 2, 2048, 128), jnp.bfloat16)
-    cached = flash_attention_cached(q[:, :128], kc, vc,
-                                    jnp.asarray(17, jnp.int32))
-    # int8-cache kernel mode (in-VMEM dequant; scale blocks are the
-    # (1, block, 1) shape the tiling rule only accepts as rank-3)
-    kc8 = (kc * 31).astype(jnp.int8)
-    vc8 = (vc * 31).astype(jnp.int8)
-    scl = jnp.full((1, 2, 2048, 1), 1 / 31.0, jnp.float32)
-    cached8 = flash_attention_cached(q[:, :128], kc8, vc8,
-                                     jnp.asarray(17, jnp.int32),
-                                     k_scale=scl, v_scale=scl)
-    # streaming variants: the default rectangular grid AND the opt-in
-    # triangular grid (S=16384 exceeds the residency budget → streaming)
-    qs, ks_, vs = (jnp.tile(x, (1, 16, 1, 1)) for x in (q, k, v))
-    stream = flash_attention(qs, ks_, vs)
-    tri = flash_attention(qs, ks_, vs, triangular=True)
-    for x in (out, g, g_tri, cached, cached8, stream, tri):
-        for leaf in jax.tree.leaves(x):       # g is (dq, dk, dv) — all three
-            assert bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
-    # value-level sign-off for the triangular grids (the docstring's gate
-    # for flipping the default): a finite-but-wrong sqrt index decode on
-    # the scalar core would slip past the isfinite loop — forward AND
-    # backward (dkv uses _tri_decode_rev, which only the bwd exercises)
-    np.testing.assert_allclose(
-        np.asarray(tri.astype(jnp.float32)),
-        np.asarray(stream.astype(jnp.float32)), atol=2e-2, rtol=2e-2)
-    for a, b in zip(g_tri, g):
-        np.testing.assert_allclose(np.asarray(a.astype(jnp.float32)),
-                                   np.asarray(b.astype(jnp.float32)),
-                                   atol=2e-2, rtol=2e-2)
