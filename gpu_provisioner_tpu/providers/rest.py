@@ -212,6 +212,9 @@ class CloudTPUQueuedResourcesClient:
         self.parent = f"/projects/{project}/locations/{location}"
         self.runtime_version = runtime_version
 
+    async def aclose(self) -> None:
+        await self.rest.aclose()
+
     def _to_wire(self, qr: QueuedResource) -> dict:
         node: dict = {
             "acceleratorType": qr.accelerator_type,
