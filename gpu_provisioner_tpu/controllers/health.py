@@ -32,6 +32,11 @@ class HealthOptions:
     # managed nodes is unhealthy (0 disables, matching the reference's
     # commented-out breaker).
     max_unhealthy_fraction: float = 0.0
+    # Watch-age liveness bound (VERDICT r4 item 9): repair deletes
+    # NodeClaims partly on a cached Node view (the breaker's list and
+    # nodeclaim correlation); refuse repair when that cache hasn't
+    # observed the apiserver within this bound. 0 disables.
+    max_cache_age: float = 600.0
 
 
 class NodeHealthController:
@@ -65,6 +70,12 @@ class NodeHealthController:
             # requeue until the toleration elapses (health/controller.go:121-127)
             return Result(requeue_after=policy.toleration_duration - elapsed)
 
+        if self._cache_too_stale():
+            log.warning("repair of %s deferred: cached cluster view older "
+                        "than %.0fs", node.metadata.name,
+                        self.opts.max_cache_age)
+            return Result(requeue_after=policy.toleration_duration)
+
         if await self._circuit_broken():
             log.warning("repair of %s skipped: cluster unhealthy fraction over limit",
                         node.metadata.name)
@@ -92,6 +103,14 @@ class NodeHealthController:
                 if c.type == policy.condition_type and c.status == policy.condition_status:
                     return c, policy
         return None
+
+    def _cache_too_stale(self) -> bool:
+        """A destructive decision must not act on a cache the watch stopped
+        feeding — see GCOptions.max_cache_age for the rationale."""
+        from .gc import _cache_age
+        if self.opts.max_cache_age <= 0:
+            return False
+        return _cache_age(self.client, Node) > self.opts.max_cache_age
 
     async def _circuit_broken(self) -> bool:
         if self.opts.max_unhealthy_fraction <= 0:

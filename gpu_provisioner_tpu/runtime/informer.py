@@ -48,6 +48,12 @@ class Informer:
         self.cls = cls
         self.resync = resync
         self.synced = False
+        # loop-time of the last watch event or successful re-list: the
+        # cache's freshness signal. A healthy informer never exceeds
+        # ~resync (the quiet-watch deadline forces a re-list); an age far
+        # past that means the watch is wedged AND re-lists are failing —
+        # decision-bearing consumers (GC, repair) bound their actions on it
+        self.last_sync: float = float("-inf")
         self._cache: dict[tuple[str, str], Object] = {}
         # label inverted index, mirroring the store's (store.py _by_label):
         # per-pool node lists at fleet scale must be O(result), not
@@ -108,12 +114,18 @@ class Informer:
             self._task = None
         self.synced = False
 
+    def age(self) -> float:
+        """Seconds since the cache last observed the apiserver (watch event
+        or successful re-list). inf before the first sync."""
+        return asyncio.get_event_loop().time() - self.last_sync
+
     async def _relist(self) -> None:
         objs = await self.client.list(self.cls)
         self._cache = {}
         self._by_label = {}
         for o in objs:
             self._upsert(o)
+        self.last_sync = asyncio.get_event_loop().time()
 
     async def _run(self) -> None:
         watch = self._watch
@@ -137,7 +149,13 @@ class Informer:
                     if ev.type == DELETED:
                         self._remove(ev.object)
                     else:
-                        self._upsert(ev.object)
+                        # CLONE before retaining: watch events share ONE
+                        # object instance across all watchers (store.py's
+                        # serde optimization) — storing it as-is would let
+                        # any future event consumer's mutation corrupt
+                        # this cache for the object's lifetime
+                        self._upsert(ev.object.deepcopy())
+                    self.last_sync = loop.time()
             except asyncio.CancelledError:
                 watch.close()
                 raise
@@ -208,6 +226,16 @@ class CachedListClient:
         self._indexes[(cls, name)] = key_fn
         if hasattr(self.inner, "add_index"):
             self.inner.add_index(cls, name, key_fn)
+
+    def cache_age(self, cls) -> float:
+        """Freshness of the cache ``list(cls)`` reads from: seconds since
+        that informer last observed the apiserver. 0.0 when the kind is
+        uncached or not yet synced — those reads pass through to the live
+        client and are always fresh."""
+        inf = self._informers.get(cls)
+        if inf is None or not inf.synced:
+            return 0.0
+        return inf.age()
 
     async def list(self, cls, labels=None, namespace=None, index=None):
         inf = self._informers.get(cls)

@@ -150,6 +150,58 @@ async def test_instance_gc_reaps_leaked_pool():
 
 
 @async_test
+async def test_gc_holds_off_on_stale_informer_cache():
+    """Watch-age liveness bound (VERDICT r4 item 9): when the informer
+    cache stops observing the apiserver (wedged watch AND failing
+    re-lists), GC must refuse to act on the stale view instead of reaping
+    a 'leak' it can no longer verify — then resume once the cache is
+    fresh again."""
+    # leak_grace longer than the time to wedge: the pool is created while
+    # the informers are LIVE (the provider's node-wait reads the cache),
+    # becomes GC-eligible only after the wedge is in place
+    opts = EnvtestOptions(gc_interval=0.05, leak_grace=0.3,
+                          use_informer=True)
+    async with Env(opts) as env:
+        loop = asyncio.get_event_loop()
+        await env.provider.create(make_nodeclaim("leak"))
+        # wedge: stop the pumps (no events, no re-lists) but keep serving
+        # the cache, and stamp it ancient
+        for inf in env.informers._informers.values():
+            await inf.stop()
+            inf.synced = True
+            inf.last_sync = loop.time() - 1e6
+        await asyncio.sleep(0.6)             # grace + several GC intervals
+        assert "leak" in env.cloud.nodepools.pools, \
+            "GC acted on a cache older than the liveness bound"
+        # un-wedge: a fresh observation lets the pass run again
+        for inf in env.informers._informers.values():
+            inf.last_sync = loop.time()
+        deadline = loop.time() + 5
+        while "leak" in env.cloud.nodepools.pools:
+            assert loop.time() < deadline, "GC never resumed after unwedge"
+            await asyncio.sleep(0.05)
+
+
+def test_health_refuses_repair_on_stale_cache_unit():
+    from gpu_provisioner_tpu.controllers.health import (HealthOptions,
+                                                        NodeHealthController)
+
+    class Stale:
+        def cache_age(self, cls):
+            return 1e9
+
+    assert NodeHealthController(Stale(), None)._cache_too_stale()
+    assert not NodeHealthController(
+        Stale(), None, options=HealthOptions(max_cache_age=0))._cache_too_stale()
+
+    class Fresh:
+        def cache_age(self, cls):
+            return 1.0
+
+    assert not NodeHealthController(Fresh(), None)._cache_too_stale()
+
+
+@async_test
 async def test_nodeclaim_gc_reaps_vanished_instance():
     async with Env() as env:
         await env.client.create(make_nodeclaim("ws0"))
