@@ -455,13 +455,11 @@ def bench_speculative(fast: bool) -> dict:
     # per-row-start decode kernel
     Bb = 2 if fast else 8
     promptb = jax.device_put(jnp.zeros((Bb, S0), jnp.int32), dev)
-    fb = jax.jit(lambda p, t: speculative_generate(
-        p, p, t, cfg, cfg, max_new_tokens=NEW, spec_k=K))
-    settle(fb(params, promptb))
+    settle(f(params, promptb))     # same jitted fn; new shape → new program
     best_b = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        r = fb(params, promptb)
+        r = f(params, promptb)
         settle(r)
         best_b = min(best_b, time.perf_counter() - t0)
     out.update({"batch": Bb, "batched_total_ms": best_b * 1e3,
@@ -508,6 +506,92 @@ def bench_moe_decode(fast: bool) -> dict:
     return {"batch": B, "prompt_len": S0, "new_tokens": NEW,
             "n_experts": cfg.n_experts, "total_ms": best * 1e3,
             "decode_tokens_per_s": B * NEW / best}
+
+
+def bench_engine(fast: bool) -> dict:
+    """Continuous batching vs static batching on a ragged request mix.
+    The engine admits a stream of requests with varying prompt/generation
+    lengths into slot rows (per-row-start decode kernel); the static
+    baseline serves the same mix in slot-sized generate() batches, each
+    padded to its batch's max prompt and max_new — the coupling
+    continuous batching exists to remove."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_provisioner_tpu.models.engine import ServeEngine
+    from gpu_provisioner_tpu.models.llama import LlamaConfig, init_params
+    from gpu_provisioner_tpu.models.decode import generate
+
+    cfg = (LlamaConfig(vocab_size=2048, dim=256, n_layers=2, n_heads=8,
+                       n_kv_heads=4, hidden_dim=512, dtype="bfloat16",
+                       attn_impl="flash")
+           if fast else
+           LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                       n_kv_heads=8, hidden_dim=5504, dtype="bfloat16",
+                       attn_impl="flash"))
+    params = init_params(jax.random.key(0), cfg)
+    slots, ML = (2, 512) if fast else (8, 2048)
+    N = 6 if fast else 24
+    rng = jax.random.split(jax.random.key(1), N)
+    lens = [int(64 + 64 * (i % 3)) for i in range(N)]          # ragged
+    news = [int(8 + 8 * (i % 4)) if fast else int(16 + 16 * (i % 4))
+            for i in range(N)]
+    # tokens start at 1: the static baseline infers pads via pad_id=0, so
+    # a genuine leading 0 would be misread as padding there
+    prompts = [jax.random.randint(rng[i], (lens[i],), 1,
+                                  cfg.vocab_size).tolist()
+               for i in range(N)]
+
+    # ONE engine for warm-up and timing: its jitted closures live on the
+    # instance, so a fresh engine would recompile everything in the timed
+    # pass; after run() drains, all slots are free for resubmission
+    eng = ServeEngine(params, cfg, slots=slots, max_len=ML,
+                      prefill_buckets=(64, 128, 256))
+
+    def run_engine():
+        for p, n in zip(prompts, news):
+            eng.submit(p, n)
+        out = dict(eng.run())      # copy — run() returns the live dict
+        eng.finished.clear()
+        return out
+
+    run_engine()                                   # compile (all buckets)
+    t0 = time.perf_counter()
+    out = run_engine()
+    dt_engine = time.perf_counter() - t0
+    total = sum(len(v) for v in out.values())
+
+    # jitted per distinct (width, new) batch shape — the static side gets
+    # the same compiled-program treatment as the engine's jitted closures
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def static_fn(w, new):
+        return jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new,
+                                             max_len=ML, pad_id=0))
+
+    def run_static():
+        done = 0
+        for i in range(0, N, slots):
+            batch = list(range(i, min(i + slots, N)))
+            w = max(lens[b] for b in batch)
+            new = max(news[b] for b in batch)
+            toks = jnp.asarray([[0] * (w - lens[b]) + prompts[b]
+                                for b in batch], jnp.int32)
+            o = static_fn(w, new)(params, toks)
+            o.block_until_ready()
+            done += sum(min(new, news[b]) for b in batch)
+        return done
+
+    run_static()                                   # compile
+    t0 = time.perf_counter()
+    done = run_static()
+    dt_static = time.perf_counter() - t0
+    return {"requests": N, "slots": slots,
+            "engine_tokens": total, "engine_ms": dt_engine * 1e3,
+            "engine_tokens_per_s": total / dt_engine,
+            "static_ms": dt_static * 1e3,
+            "static_tokens_per_s": done / dt_static,
+            "speedup_vs_static": (total / dt_engine) / (done / dt_static)}
 
 
 def bench_flash_op(fast: bool) -> dict:
@@ -682,6 +766,7 @@ def _tpu_sections():
         ("flash_attention", bench_flash_op, 2),
         ("moe_decode", bench_moe_decode, 2),
         ("speculative", bench_speculative, 2),
+        ("engine", bench_engine, 2),
         ("long_context", bench_long_context, 2),
         ("train", bench_train_step, 4),
         ("prefill_cached", bench_cached_prefill, 2),

@@ -1,0 +1,284 @@
+"""Continuous-batching serving engine over the per-row KV-cache machinery.
+
+Production serving traffic is a STREAM: requests arrive at arbitrary times
+with varying prompt and generation lengths. Static-batch ``generate()``
+couples every row to the batch's slowest member — a 16-token completion
+waits for a 512-token neighbour, and no new request can start until the
+whole batch drains. The engine decouples them with SLOTS (the continuous
+batching of modern serving stacks, built TPU-first):
+
+- one pre-allocated cache of ``slots`` rows at a fixed ``max_len`` budget
+  (static shapes — the decode step compiles exactly once);
+- every decode step advances ALL active slots together through one
+  ``cached_forward`` call with a per-row length vector — the per-row-start
+  decode kernel fetches each row's own live prefix, so a fresh request
+  next to a long-running one costs O(its own length), not O(max_len);
+- a finished slot (eos or token budget) frees immediately and the next
+  queued request is admitted into it: prompts left-pad to a small set of
+  BUCKET lengths (one prefill program per bucket, compiled once each) and
+  prefill into a single-row cache that is then inserted into the slot —
+  in-cache pads stay masked forever via the engine's per-slot pad vector,
+  and RoPE counts from each row's first real token, so a slotted request
+  generates exactly what it would alone (the repo's padded-row invariant);
+- inactive slots ride through the shared step with their write offset
+  parked in-bounds and their length restored afterwards (the same
+  finished-row discipline as batched speculative decoding) — they cost
+  FLOPs (static shapes) but never corrupt state.
+
+Greedy engine output per request is EXACTLY ``generate()``'s stream for
+that request (tested); sampled mode draws per-step from the same filtered
+distribution. Both model families serve (dense and MoE dispatch once at
+construction). MoE bucketing semantic: expert capacity for the prefill is
+computed from the BUCKET length (pads claim no capacity but widen the
+denominator-S capacity formula) — the same documented routing-semantics
+class as chunked prefill's per-chunk capacity; the engine stream equals
+generate() on the identically-padded prompt, and decode steps are
+dropless either way. The host loop owns admission only — one device→host
+sync per step (the emitted tokens), which admission decisions need
+anyway.
+
+Reference parity note: workload-side scope beyond the reference
+(SURVEY.md §2c) — the serving stack the provisioned slices exist to run;
+sits on models/decode.py:cached_forward and the per-row-start kernel
+(ops/flash_attention.py:flash_attention_decode).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import (KVCache, filter_logits, init_kv_cache,
+                     validate_sampling_args)
+from .llama import LlamaConfig, resolve_attn as _resolve_attn
+
+DEFAULT_BUCKETS = (64, 128, 256, 512, 1024)
+
+
+@dataclass
+class Request:
+    req_id: int
+    prompt: list[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+
+
+@dataclass
+class _Slot:
+    req: Request
+    emitted: list[int] = field(default_factory=list)
+
+
+class ServeEngine:
+    """Slot-based continuous batching for one model.
+
+    ``slots``: concurrent sequences (the static decode batch width).
+    ``max_len``: per-slot cache budget; every request must satisfy
+    bucket(prompt) + max_new_tokens <= max_len.
+    ``prefill_buckets``: ascending prompt-pad lengths — one compiled
+    prefill program per DISTINCT bucket actually used.
+    Sampling (``temperature``/``top_k``/``top_p``/``key``) follows
+    generate()'s argument contract exactly."""
+
+    def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
+                 max_len: int = 2048,
+                 prefill_buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 temperature: float = 0.0, top_k: int = None,
+                 top_p: int = None, key=None):
+        _resolve_attn(cfg.attn_impl, cfg.sliding_window,
+                      cfg.attn_sinks)        # loud validation, as everywhere
+        validate_sampling_args(temperature, top_k, top_p, key)
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.buckets = tuple(sorted(set(prefill_buckets)))
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self._key = key
+
+        from .moe import MoEConfig
+        if isinstance(cfg, MoEConfig):
+            from .moe_serve import moe_cached_forward as _fwd
+        else:
+            from .decode import cached_forward as _fwd
+
+        def _step(params, tok, cache, pads, active, key):
+            # inactive slots: park the write offset in-bounds (their write
+            # is discarded) and restore the length afterwards — the
+            # finished-row discipline from speculative_generate
+            parked = jnp.minimum(cache.length, max_len - 1)
+            safe = jnp.where(active, cache.length, parked)
+            cache = cache._replace(length=safe)
+            logits, cache = _fwd(params, tok, cache, cfg, pad_lens=pads)
+            cache = cache._replace(
+                length=jnp.where(active, cache.length, safe))
+            lg = logits[:, 0]
+            if temperature > 0:
+                nxt = jax.random.categorical(
+                    key, filter_logits(lg, temperature, top_k, top_p),
+                    axis=-1).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return nxt, cache
+
+        self._step = jax.jit(_step, donate_argnums=(2,))
+
+        def _prefill(params, prompt, cache1, pads1):
+            # B=1 general cached forward at offset 0 (left-padded bucket)
+            logits, cache1 = _fwd(params, prompt, cache1, cfg,
+                                  pad_lens=pads1)
+            lg = logits[:, -1]
+            return lg, cache1
+
+        self._prefill = jax.jit(_prefill)    # compiles per bucket length
+
+        def _insert(big: KVCache, small: KVCache, slot, length):
+            def put(b, s):
+                return jax.lax.dynamic_update_slice(
+                    b, s, (0, slot, 0, 0, 0)) if b is not None else None
+            return KVCache(k=put(big.k, small.k), v=put(big.v, small.v),
+                           length=big.length.at[slot].set(length),
+                           k_scale=put(big.k_scale, small.k_scale),
+                           v_scale=put(big.v_scale, small.v_scale))
+
+        self._insert = jax.jit(_insert, donate_argnums=(0,))
+
+        self.cache = init_kv_cache(cfg, slots, max_len)
+        self.cache = self.cache._replace(
+            length=jnp.zeros((slots,), jnp.int32))
+        self._pads = jnp.zeros((slots,), jnp.int32)
+        self._last = jnp.zeros((slots,), jnp.int32)
+        self._slot: list[Optional[_Slot]] = [None] * slots
+        self._queue: deque[Request] = deque()
+        self._next_id = 0
+        self.finished: dict[int, list[int]] = {}
+
+    # --- request lifecycle --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None) -> int:
+        """Queue a request; returns its id. Raises if it cannot ever fit."""
+        prompt = list(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens} (admission always emits "
+                             "the prefill token)")
+        b = self._bucket(len(prompt))
+        if b + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request needs bucket {b} + {max_new_tokens} new tokens "
+                f"> max_len {self.max_len}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(Request(rid, prompt, max_new_tokens, eos_id))
+        return rid
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket "
+                         f"{self.buckets[-1]}")
+
+    def _admit(self, emitted: dict[int, list[int]]) -> None:
+        """Fill free slots from the queue; admission itself emits each
+        request's FIRST token (from the prefill logits) into ``emitted``."""
+        for s in range(self.slots):
+            if not self._queue:
+                return
+            if self._slot[s] is not None:
+                continue
+            req = self._queue.popleft()
+            b = self._bucket(len(req.prompt))
+            pad = b - len(req.prompt)
+            prompt = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
+            cache1 = init_kv_cache(self.cfg, 1, self.max_len)
+            lg, cache1 = self._prefill(self.params, prompt, cache1,
+                                       jnp.asarray([pad], jnp.int32))
+            if self.temperature > 0:
+                self._key, k0 = jax.random.split(self._key)
+                tok0 = jax.random.categorical(
+                    k0, filter_logits(lg, self.temperature, self.top_k,
+                                      self.top_p), axis=-1)
+            else:
+                tok0 = jnp.argmax(lg, axis=-1)
+            tok0 = int(tok0[0])
+            self.cache = self._insert(self.cache, cache1,
+                                      jnp.asarray(s, jnp.int32),
+                                      jnp.asarray(b, jnp.int32))
+            self._pads = self._pads.at[s].set(pad)
+            self._last = self._last.at[s].set(tok0)
+            self._slot[s] = _Slot(req, [tok0])
+            emitted.setdefault(req.req_id, []).append(tok0)
+            self._maybe_finish(s)
+
+    def _maybe_finish(self, s: int) -> None:
+        slot = self._slot[s]
+        req = slot.req
+        done = len(slot.emitted) >= req.max_new_tokens or (
+            req.eos_id is not None and slot.emitted[-1] == req.eos_id)
+        if done:
+            self.finished[req.req_id] = slot.emitted
+            self._slot[s] = None
+            self.cache = self.cache._replace(
+                length=self.cache.length.at[s].set(0))
+
+    # --- the serving loop ---------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(s is not None for s in self._slot)
+
+    def step(self) -> dict[int, list[int]]:
+        """Admit what fits, then advance every active slot one token.
+        Returns {req_id: [tokens]} for EVERY token emitted this step — a
+        newly-admitted request contributes its first token (from the
+        prefill logits) plus, if still active, this step's decode token;
+        a request that finishes during admission thus still surfaces
+        here."""
+        out: dict[int, list[int]] = {}
+        self._admit(out)
+        active_slots = [i for i, s in enumerate(self._slot) if s is not None]
+        if not active_slots:
+            return out
+        active = jnp.asarray([s is not None for s in self._slot])
+        if self.temperature > 0:
+            self._key, kt = jax.random.split(self._key)
+        else:
+            kt = jax.random.key(0)
+        nxt, self.cache = self._step(self.params, self._last[:, None],
+                                     self.cache, self._pads, active, kt)
+        self._last = nxt
+        toks = np.asarray(nxt)               # the one host sync per step
+        for s in active_slots:
+            t = int(toks[s])
+            slot = self._slot[s]
+            slot.emitted.append(t)
+            out.setdefault(slot.req.req_id, []).append(t)
+            self._maybe_finish(s)
+        return out
+
+    def run(self, max_steps: int = 100_000) -> dict[int, list[int]]:
+        """Drive until every submitted request finishes; returns
+        {req_id: emitted tokens}."""
+        steps = 0
+        while self.pending:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"engine did not drain in {max_steps} "
+                                   f"steps ({self.pending} pending)")
+        return self.finished
+
+
+__all__ = ["ServeEngine", "Request", "DEFAULT_BUCKETS"]
