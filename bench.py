@@ -586,12 +586,39 @@ def bench_engine(fast: bool) -> dict:
     t0 = time.perf_counter()
     done = run_static()
     dt_static = time.perf_counter() - t0
+
+    # continuous batching × speculation with a SELF-draft: acceptance is
+    # 100%, so this isolates the speculation PLUMBING cost (draft scan +
+    # wide verify + rollback) at full acceptance — NOT a speedup bound:
+    # the self-draft pays full target cost per draft step, so a real
+    # (cheap) draft with good acceptance beats this ratio, and a ratio
+    # near spec-cost parity means the machinery itself is lean
+    eng_s = ServeEngine(params, cfg, slots=slots, max_len=ML,
+                        prefill_buckets=(64, 128, 256),
+                        draft_params=params, draft_cfg=cfg, spec_k=3)
+
+    def run_spec():
+        for p, n in zip(prompts, news):
+            eng_s.submit(p, n)
+        out = dict(eng_s.run())
+        eng_s.finished.clear()
+        return out
+
+    run_spec()                                     # compile
+    t0 = time.perf_counter()
+    out_s = run_spec()
+    dt_spec = time.perf_counter() - t0
+    total_s = sum(len(v) for v in out_s.values())
     return {"requests": N, "slots": slots,
             "engine_tokens": total, "engine_ms": dt_engine * 1e3,
             "engine_tokens_per_s": total / dt_engine,
             "static_ms": dt_static * 1e3,
             "static_tokens_per_s": done / dt_static,
-            "speedup_vs_static": (total / dt_engine) / (done / dt_static)}
+            "speedup_vs_static": (total / dt_engine) / (done / dt_static),
+            "spec_engine_selfdraft_ms": dt_spec * 1e3,
+            "spec_engine_selfdraft_tokens_per_s": total_s / dt_spec,
+            "spec_selfdraft_cost_ratio": (total_s / dt_spec)
+                                         / (total / dt_engine)}
 
 
 def bench_flash_op(fast: bool) -> dict:

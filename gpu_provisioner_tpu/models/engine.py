@@ -80,22 +80,41 @@ class ServeEngine:
 
     ``slots``: concurrent sequences (the static decode batch width).
     ``max_len``: per-slot cache budget; every request must satisfy
-    bucket(prompt) + max_new_tokens <= max_len.
+    bucket(prompt) + max_new_tokens (+ verify slack when speculating)
+    <= max_len.
     ``prefill_buckets``: ascending prompt-pad lengths — one compiled
     prefill program per DISTINCT bucket actually used.
     Sampling (``temperature``/``top_k``/``top_p``/``key``) follows
-    generate()'s argument contract exactly."""
+    generate()'s argument contract exactly.
+    ``draft_params``/``draft_cfg``/``spec_k``: SPECULATIVE serving — each
+    engine step runs one spec_round (models/speculative.py) across all
+    active slots: the draft proposes spec_k tokens per slot, one wide
+    verify call scores them, and each slot emits its accepted prefix + 1
+    (so a step emits 1..spec_k+1 tokens per slot). Greedy speculative
+    slots emit exactly the plain engine's stream (MoE targets verify
+    drop-free); sampled slots draw from the target's filtered
+    distribution via rejection sampling. The draft prefills and slots
+    alongside the target (its own cache pool, same buckets/pads)."""
 
     def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
                  max_len: int = 2048,
                  prefill_buckets: tuple[int, ...] = DEFAULT_BUCKETS,
                  temperature: float = 0.0, top_k: int = None,
-                 top_p: int = None, key=None):
+                 top_p: int = None, key=None,
+                 draft_params=None, draft_cfg: LlamaConfig = None,
+                 spec_k: int = 4):
         _resolve_attn(cfg.attn_impl, cfg.sliding_window,
                       cfg.attn_sinks)        # loud validation, as everywhere
         validate_sampling_args(temperature, top_k, top_p, key)
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg come together")
+        if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError("draft and target must share a vocabulary: "
+                             f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
+        if draft_cfg is not None and spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -103,21 +122,25 @@ class ServeEngine:
         self.buckets = tuple(sorted(set(prefill_buckets)))
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self._key = key
+        self.draft_params, self.draft_cfg = draft_params, draft_cfg
+        self.spec_k = spec_k
+        # speculative slots need verify slack past the budget: a round may
+        # write spec_k+1 entries at the row's current length
+        self._slack = (spec_k + 1) if draft_cfg is not None else 0
 
-        from .moe import MoEConfig
-        if isinstance(cfg, MoEConfig):
-            from .moe_serve import moe_cached_forward as _fwd
-        else:
-            from .decode import cached_forward as _fwd
+        from .decode import family_fns
 
         def _step(params, tok, cache, pads, active, key):
             # inactive slots: park the write offset in-bounds (their write
             # is discarded) and restore the length afterwards — the
-            # finished-row discipline from speculative_generate
+            # finished-row discipline from speculative_generate.
+            # family_fns is THE family dispatch point (dense vs MoE) —
+            # the engine serves the same code path as generate()
             parked = jnp.minimum(cache.length, max_len - 1)
             safe = jnp.where(active, cache.length, parked)
             cache = cache._replace(length=safe)
-            logits, cache = _fwd(params, tok, cache, cfg, pad_lens=pads)
+            logits, cache = family_fns(cfg, pad_lens=pads)[1](params, tok,
+                                                              cache)
             cache = cache._replace(
                 length=jnp.where(active, cache.length, safe))
             lg = logits[:, 0]
@@ -133,8 +156,8 @@ class ServeEngine:
 
         def _prefill(params, prompt, cache1, pads1):
             # B=1 general cached forward at offset 0 (left-padded bucket)
-            logits, cache1 = _fwd(params, prompt, cache1, cfg,
-                                  pad_lens=pads1)
+            logits, cache1 = family_fns(cfg, pad_lens=pads1)[1](
+                params, prompt, cache1)
             lg = logits[:, -1]
             return lg, cache1
 
@@ -150,6 +173,45 @@ class ServeEngine:
                            v_scale=put(big.v_scale, small.v_scale))
 
         self._insert = jax.jit(_insert, donate_argnums=(0,))
+
+        if draft_cfg is not None:
+            from .decode import family_fns
+            from .speculative import spec_round
+
+            def _spec_step(params, dparams, last, done, cache_t, cache_d,
+                           pads, key):
+                # family_fns is THE dispatch point (dense vs MoE, and the
+                # MoE dropless-verify rule) — the engine must serve the
+                # same code path as speculative_generate
+                step_t = family_fns(cfg, pad_lens=pads,
+                                    dropless_step=True)[1]
+                step_d = family_fns(draft_cfg, pad_lens=pads)[1]
+                (emit_vec, _keep, emit_n, new_last, cache_t, cache_d,
+                 _logits) = spec_round(
+                    step_t, step_d, params, dparams, last, done, cache_t,
+                    cache_d, key, spec_k=spec_k,
+                    draft_vocab=draft_cfg.vocab_size, max_len=max_len,
+                    sampled=temperature > 0, temperature=temperature,
+                    top_k=top_k, top_p=top_p)
+                # pack the two host-bound outputs into ONE transfer and
+                # drop the [slots, k+1, V] verify logits on device — jit
+                # outputs cannot be DCE'd, so returning them would write
+                # MBs of never-read HBM per step
+                packed = jnp.concatenate([emit_vec, emit_n[:, None]],
+                                         axis=1)          # [slots, k+2]
+                return packed, new_last, cache_t, cache_d
+
+            self._spec_step = jax.jit(_spec_step, donate_argnums=(4, 5))
+
+            def _dprefill(dparams, prompt, cache1, pads1):
+                logits, cache1 = family_fns(
+                    draft_cfg, pad_lens=pads1)[1](dparams, prompt, cache1)
+                return logits[:, -1], cache1
+
+            self._dprefill = jax.jit(_dprefill)
+            self.draft_cache = init_kv_cache(draft_cfg, slots, max_len)
+            self.draft_cache = self.draft_cache._replace(
+                length=jnp.zeros((slots,), jnp.int32))
 
         self.cache = init_kv_cache(cfg, slots, max_len)
         self.cache = self.cache._replace(
@@ -174,10 +236,13 @@ class ServeEngine:
                              f"{max_new_tokens} (admission always emits "
                              "the prefill token)")
         b = self._bucket(len(prompt))
-        if b + max_new_tokens > self.max_len:
+        if b + max_new_tokens + self._slack > self.max_len:
+            # speculative engines add verify slack: a round may write
+            # spec_k+1 entries at the row's current length
             raise ValueError(
                 f"request needs bucket {b} + {max_new_tokens} new tokens "
-                f"> max_len {self.max_len}")
+                + (f"+ {self._slack} verify slack " if self._slack else "")
+                + f"> max_len {self.max_len}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(rid, prompt, max_new_tokens, eos_id))
@@ -216,6 +281,14 @@ class ServeEngine:
             self.cache = self._insert(self.cache, cache1,
                                       jnp.asarray(s, jnp.int32),
                                       jnp.asarray(b, jnp.int32))
+            if self.draft_cfg is not None:
+                dcache1 = init_kv_cache(self.draft_cfg, 1, self.max_len)
+                _, dcache1 = self._dprefill(
+                    self.draft_params, prompt, dcache1,
+                    jnp.asarray([pad], jnp.int32))
+                self.draft_cache = self._insert(
+                    self.draft_cache, dcache1, jnp.asarray(s, jnp.int32),
+                    jnp.asarray(b, jnp.int32))
             self._pads = self._pads.at[s].set(pad)
             self._last = self._last.at[s].set(tok0)
             self._slot[s] = _Slot(req, [tok0])
@@ -232,6 +305,9 @@ class ServeEngine:
             self._slot[s] = None
             self.cache = self.cache._replace(
                 length=self.cache.length.at[s].set(0))
+            if self.draft_cfg is not None:
+                self.draft_cache = self.draft_cache._replace(
+                    length=self.draft_cache.length.at[s].set(0))
 
     # --- the serving loop ---------------------------------------------------
 
@@ -256,6 +332,8 @@ class ServeEngine:
             self._key, kt = jax.random.split(self._key)
         else:
             kt = jax.random.key(0)
+        if self.draft_cfg is not None:
+            return self._spec_advance(out, active_slots, active, kt)
         nxt, self.cache = self._step(self.params, self._last[:, None],
                                      self.cache, self._pads, active, kt)
         self._last = nxt
@@ -265,6 +343,30 @@ class ServeEngine:
             slot = self._slot[s]
             slot.emitted.append(t)
             out.setdefault(slot.req.req_id, []).append(t)
+            self._maybe_finish(s)
+        return out
+
+    def _spec_advance(self, out, active_slots, active, kt):
+        """One speculative round for every active slot: 1..spec_k+1 tokens
+        per slot per step. Quota/eos truncation happens host-side — a
+        truncated slot always FINISHES, so its device state (which ran
+        ahead by the truncated tokens) is discarded with the slot."""
+        packed, new_last, self.cache, self.draft_cache = self._spec_step(
+            self.params, self.draft_params, self._last, ~active,
+            self.cache, self.draft_cache, self._pads, kt)
+        self._last = new_last
+        host = np.asarray(packed)            # the one host sync per step
+        ev, en = host[:, :-1], host[:, -1]
+        for s in active_slots:
+            slot = self._slot[s]
+            req = slot.req
+            new = [int(t) for t in ev[s][:int(en[s])]]
+            new = new[:req.max_new_tokens - len(slot.emitted)]
+            if req.eos_id is not None and req.eos_id in new:
+                new = new[:new.index(req.eos_id) + 1]
+            slot.emitted.extend(new)
+            if new:
+                out.setdefault(req.req_id, []).extend(new)
             self._maybe_finish(s)
         return out
 

@@ -95,6 +95,105 @@ def _spec_accept(key, proposal, p_d, p_t):
     return m, bonus.astype(jnp.int32)
 
 
+def spec_round(step_t, step_d, params, draft_params, last, done,
+               cache_t, cache_d, key, *, spec_k: int, draft_vocab: int,
+               max_len: int, sampled: bool, temperature: float = 0.0,
+               top_k=None, top_p=None):
+    """ONE speculative round for a batch of rows — the shared core of
+    ``speculative_generate``'s loop body and the serving engine's
+    speculative step. ``last`` [B]: each row's previous token; ``done``
+    [B]: rows that must emit nothing (their round rolls back in full and
+    their caches never advance). Returns (emit_vec [B, spec_k+1], keep
+    [B, spec_k+1] bool — True at emitted positions, emit_n [B], new_last
+    [B], cache_t, cache_d, verify_logits [B, spec_k+1, V] — the target's
+    logits at each block position, FILTERED when sampled, for logprob
+    scoring)."""
+    B = last.shape[0]
+    kd, ka = jax.random.split(key)
+
+    # A FINISHED row still flows through the round's k+1 writes (static
+    # shapes), and its frozen length can sit as high as
+    # S0+max_new+spec_k — writing k+1 entries there would escape max_len
+    # (dynamic_update_slice would clamp and silently overwrite the live
+    # tail). Clamp finished rows' write offset into bounds: everything a
+    # finished row writes is discarded (it is never queried again), so
+    # parking its writes at the bound keeps cached_forward's precondition
+    # intact for every row. Active rows are in-bounds by callers' max_len
+    # budgeting.
+    bound = max_len - (spec_k + 1)
+    cache_t = cache_t._replace(
+        length=jnp.where(done, jnp.minimum(cache_t.length, bound),
+                         cache_t.length))
+    cache_d = cache_d._replace(
+        length=jnp.where(done, jnp.minimum(cache_d.length, bound),
+                         cache_d.length))
+
+    # --- draft phase: k+1 serial cheap steps -------------------------------
+    # step i consumes token i of [last, d1..dk]; the (k+1)-th write puts
+    # d_k's kv in the draft cache so a fully-accepted round leaves the
+    # draft consistent without a special case
+    def draft_scan(c, kt):
+        cache_d, tok = c
+        lg, cache_d = step_d(draft_params, tok[:, None], cache_d)
+        if sampled:
+            fl = filter_logits(lg[:, 0], temperature, top_k, top_p)
+            probs = jax.nn.softmax(fl, axis=-1)             # [B, V]
+            nxt = jax.random.categorical(kt, fl,
+                                         axis=-1).astype(jnp.int32)
+        else:
+            probs = jnp.zeros((B, draft_vocab))             # unused
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (cache_d, nxt), (nxt, probs)
+
+    (cache_d, _), (drafts, draft_probs) = lax.scan(
+        draft_scan, (cache_d, last), jax.random.split(kd, spec_k + 1))
+    drafts = drafts.transpose(1, 0)                 # [B, k+1]
+    proposal = drafts[:, :spec_k]                   # d_1..d_k
+
+    # --- target phase: ONE wide verify call --------------------------------
+    block = jnp.concatenate([last[:, None], proposal], axis=1)
+    lg, cache_t = step_t(params, block, cache_t)    # [B, k+1, V]
+
+    if sampled:
+        fl_t = filter_logits(lg, temperature, top_k, top_p)
+        p_t = jax.nn.softmax(fl_t, axis=-1)         # [B, k+1, V]
+        dp = draft_probs.transpose(1, 0, 2)[:, :spec_k]  # [B, k, V]
+        m, bonus = jax.vmap(_spec_accept)(
+            jax.random.split(ka, B), proposal, dp, p_t)  # [B], [B]
+        # emitted = accepted draft tokens then the bonus draw
+        prop_pad = jnp.concatenate(
+            [proposal, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        emit_vec = jnp.where(jnp.arange(spec_k + 1)[None] < m[:, None],
+                             prop_pad, bonus[:, None])   # [B, k+1]
+        new_last = bonus
+        verify_logits = fl_t
+    else:
+        preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [B, k+1]
+        # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
+        agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
+        # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}
+        emit_vec = preds
+        new_last = preds[jnp.arange(B), m]                  # p_{m+1}
+        verify_logits = lg
+    # finished rows emit NOTHING this round (m = −1 ⇒ emit_n = 0 and the
+    # rollback below drops every entry the round wrote)
+    m = jnp.where(done, -1, m)
+    emit_n = m + 1                                          # [B]
+    new_last = jnp.where(done, last, new_last)
+    keep = jnp.arange(spec_k + 1)[None] < emit_n[:, None]   # [B, k+1]
+
+    # --- rollback to the accepted state ------------------------------------
+    # target wrote k+1 entries ([last, d1..dk]) at each row's offset;
+    # accepted needs [.., last, d1..dm] → drop (k - m). draft wrote k+1
+    # entries and the next round feeds new_last, so it also keeps
+    # [.., last, d1..dm] → drop (k - m). (done rows: m = −1 drops all
+    # k+1 — their caches never advance.)
+    cache_t = cache_t._replace(length=cache_t.length - (spec_k - m))
+    cache_d = cache_d._replace(length=cache_d.length - (spec_k - m))
+    return emit_vec, keep, emit_n, new_last, cache_t, cache_d, verify_logits
+
+
 def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
                          draft_cfg: LlamaConfig, *, max_new_tokens: int,
                          spec_k: int = 4, max_len: int = None,
@@ -213,82 +312,17 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
 
     def body(carry):
         out, lp, n, last, done, cache_t, cache_d, calls, key = carry
-        key, kd, ka = jax.random.split(key, 3)
-
-        # A FINISHED row still flows through the round's k+1 writes (static
-        # shapes), and its frozen length can sit as high as
-        # S0+max_new+spec_k — writing k+1 entries there would escape
-        # max_len (dynamic_update_slice would clamp and silently overwrite
-        # the live tail). Clamp finished rows' write offset into bounds:
-        # everything a finished row writes is discarded (it is never
-        # queried again and the caches are not returned), so parking its
-        # writes at the bound keeps cached_forward's precondition intact
-        # for every row. Active rows are in-bounds by the max_len guard.
-        safe = jnp.minimum(cache_t.length, max_len - (spec_k + 1))
-        cache_t = cache_t._replace(
-            length=jnp.where(done, safe, cache_t.length))
-        cache_d = cache_d._replace(
-            length=jnp.where(done, jnp.minimum(cache_d.length,
-                                               max_len - (spec_k + 1)),
-                             cache_d.length))
-
-        # --- draft phase: k+1 serial cheap steps -----------------------
-        # step i consumes token i of [last, d1..dk]; the (k+1)-th write
-        # puts d_k's kv in the draft cache so a fully-accepted round
-        # leaves the draft consistent without a special case
-        def draft_scan(c, kt):
-            cache_d, tok = c
-            lg, cache_d = step_d(draft_params, tok[:, None], cache_d)
-            if sampled:
-                fl = filter_logits(lg[:, 0], temperature, top_k, top_p)
-                probs = jax.nn.softmax(fl, axis=-1)             # [B, V]
-                nxt = jax.random.categorical(kt, fl,
-                                             axis=-1).astype(jnp.int32)
-            else:
-                probs = jnp.zeros((B, draft_cfg.vocab_size))    # unused
-                nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
-            return (cache_d, nxt), (nxt, probs)
-
-        (cache_d, _), (drafts, draft_probs) = lax.scan(
-            draft_scan, (cache_d, last), jax.random.split(kd, spec_k + 1))
-        drafts = drafts.transpose(1, 0)                 # [B, k+1]
-        proposal = drafts[:, :spec_k]                   # d_1..d_k
-
-        # --- target phase: ONE wide verify call ------------------------
-        block = jnp.concatenate([last[:, None], proposal], axis=1)
-        lg, cache_t = step_t(params, block, cache_t)    # [B, k+1, V]
+        key, kr = jax.random.split(key)
+        (emit_vec, keep, emit_n, new_last, cache_t, cache_d,
+         verify_logits) = spec_round(
+            step_t, step_d, params, draft_params, last, done, cache_t,
+            cache_d, kr, spec_k=spec_k, draft_vocab=draft_cfg.vocab_size,
+            max_len=max_len, sampled=sampled, temperature=temperature,
+            top_k=top_k, top_p=top_p)
         calls = calls + 1
-
-        if sampled:
-            fl_t = filter_logits(lg, temperature, top_k, top_p)
-            p_t = jax.nn.softmax(fl_t, axis=-1)         # [B, k+1, V]
-            dp = draft_probs.transpose(1, 0, 2)[:, :spec_k]  # [B, k, V]
-            m, bonus = jax.vmap(_spec_accept)(
-                jax.random.split(ka, B), proposal, dp, p_t)  # [B], [B]
-            # emitted = accepted draft tokens then the bonus draw
-            prop_pad = jnp.concatenate(
-                [proposal, jnp.zeros((B, 1), jnp.int32)], axis=1)
-            emit_vec = jnp.where(jnp.arange(spec_k + 1)[None] < m[:, None],
-                                 prop_pad, bonus[:, None])   # [B, k+1]
-            new_last = bonus
-        else:
-            preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [B, k+1]
-            # longest agreeing prefix: m = #{i : d_i == p_i, all j<i agree}
-            agree = (proposal == preds[:, :spec_k]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
-            # emitted tokens = p_1..p_m (== d_1..d_m) then bonus p_{m+1}
-            emit_vec = preds
-            new_last = preds[jnp.arange(B), m]                  # p_{m+1}
-        # finished rows emit NOTHING this round (m = −1 ⇒ emit_n = 0 and
-        # the rollback below drops every entry the round wrote)
-        m = jnp.where(done, -1, m)
-        emit_n = m + 1                                          # [B]
-        new_last = jnp.where(done, last, new_last)
 
         # write the full fixed window PER ROW at its own offset, masked so
         # positions ≥ emit_n keep their old buffer contents
-        keep = jnp.arange(spec_k + 1)[None] < emit_n[:, None]   # [B, k+1]
-
         def row_update(buf_row, n_b, new_b, keep_b):
             window = lax.dynamic_slice(buf_row, (n_b,), (spec_k + 1,))
             return lax.dynamic_update_slice(
@@ -296,25 +330,14 @@ def speculative_generate(params, draft_params, prompt, cfg: LlamaConfig,
 
         out = jax.vmap(row_update)(out, n, emit_vec, keep)
         if return_logprobs:
-            # each emitted token scored under the target's distribution
-            # at its own position (lg[b, i] is the dist after prefix+d_<i);
-            # sampled mode reuses the already-filtered logits
-            ld = (jax.nn.log_softmax(fl_t, axis=-1) if sampled
-                  else jax.nn.log_softmax(lg, axis=-1))   # [B, k+1, V]
+            # each emitted token scored under the target's distribution at
+            # its own position (verify_logits[b, i] is the dist after
+            # prefix+d_<i; already filtered in sampled mode)
+            ld = jax.nn.log_softmax(verify_logits, axis=-1)  # [B, k+1, V]
             wlp = jnp.take_along_axis(ld, emit_vec[..., None],
                                       axis=-1)[..., 0]    # [B, k+1]
             lp = jax.vmap(row_update)(lp, n, wlp, keep)
 
-        # --- rollback to the accepted state ----------------------------
-        # target wrote k+1 entries ([last, d1..dk]) at each row's offset;
-        # accepted needs [.., last, d1..dm] → drop (k - m). draft wrote
-        # k+1 entries and the next round feeds new_last, so it also keeps
-        # [.., last, d1..dm] → drop (k - m). (done rows: m = −1 drops all
-        # k+1 — their caches never advance.)
-        cache_t = cache_t._replace(
-            length=cache_t.length - (spec_k - m))
-        cache_d = cache_d._replace(
-            length=cache_d.length - (spec_k - m))
         n = n + emit_n
         done = done | (n >= max_new_tokens)
         if eos_id is not None:

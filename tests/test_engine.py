@@ -110,6 +110,20 @@ def test_engine_flash_kernels_and_moe():
     assert mout[rid] == [int(t) for t in want[0]]
 
 
+def test_engine_int8_cache():
+    """The memory-constrained serving configuration: int8 KV cache rides
+    the same insert/step machinery (scales inserted alongside values)."""
+    cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    eng = ServeEngine(PARAMS, cfg8, slots=2, max_len=64,
+                      prefill_buckets=(16,))
+    p = _prompt(30, 9)
+    rid = eng.submit(p, 6)
+    out = eng.run()
+    want = generate(PARAMS, jnp.asarray([p], jnp.int32), cfg8,
+                    max_new_tokens=6, max_len=64)
+    assert out[rid] == [int(t) for t in want[0]]
+
+
 def test_engine_sampled_mode_in_vocab():
     eng = ServeEngine(PARAMS, CFG, slots=2, max_len=64,
                       prefill_buckets=(16,), temperature=0.9, top_k=40,
@@ -139,6 +153,73 @@ def test_engine_streaming_step_contract():
     assert streams[r1] == eng.finished[r1] == _solo(_prompt(20, 8), 5)
 
 
+def test_engine_speculative_matches_plain_streams():
+    """Speculative engine slots (draft per round, wide verify, per-slot
+    acceptance) emit exactly the plain greedy streams — including slot
+    reuse, staggered arrival, quota truncation of the last window, and
+    per-request eos."""
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(jax.random.key(3), draft_cfg)
+    eng = ServeEngine(PARAMS, CFG, slots=2, max_len=64,
+                      prefill_buckets=(16,), draft_params=draft,
+                      draft_cfg=draft_cfg, spec_k=3)
+    rids = {eng.submit(_prompt(40 + i, 8 + i), 5 + i): (40 + i, 8 + i,
+                                                       5 + i)
+            for i in range(3)}
+    eng.step()
+    rids[eng.submit(_prompt(44, 12), 7)] = (44, 12, 7)   # mid-flight
+    out = eng.run()
+    for rid, (seed, n, new) in rids.items():
+        assert out[rid] == _solo(_prompt(seed, n), new), f"req {rid}"
+
+    # self-draft: full acceptance — finishes in ~ceil(new/k+1) steps/slot
+    eng2 = ServeEngine(PARAMS, CFG, slots=1, max_len=64,
+                       prefill_buckets=(16,), draft_params=PARAMS,
+                       draft_cfg=CFG, spec_k=3)
+    r = eng2.submit(_prompt(45, 8), 8)
+    steps = 0
+    while eng2.pending:
+        eng2.step()
+        steps += 1
+    assert eng2.finished[r] == _solo(_prompt(45, 8), 8)
+    assert steps <= 3                      # 1 admit-token + 2 full rounds
+
+    # eos inside an accepted window truncates and frees the slot
+    free = _solo(_prompt(46, 10), 12)
+    eos = free[3]
+    want = _solo(_prompt(46, 10), 12, eos_id=eos)
+    eng3 = ServeEngine(PARAMS, CFG, slots=1, max_len=64,
+                       prefill_buckets=(16,), draft_params=PARAMS,
+                       draft_cfg=CFG, spec_k=3)
+    r = eng3.submit(_prompt(46, 10), 12, eos_id=eos)
+    out3 = eng3.run()
+    k = out3[r].index(eos) + 1
+    assert out3[r] == want[:k] and eos in out3[r]
+
+
+def test_engine_speculative_moe_target():
+    """Speculative engine with a Mixtral-capacity MoE target: drop-free
+    verify keeps slot streams equal to the plain engine's."""
+    from gpu_provisioner_tpu.models.moe import MoEConfig, init_moe_model
+
+    mcfg = MoEConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     n_experts=8, experts_per_token=2,
+                     capacity_factor=1.25, dtype="float32")
+    mp = init_moe_model(jax.random.key(9), mcfg)
+    draft_cfg = dataclasses.replace(CFG)
+    draft = init_params(jax.random.key(3), draft_cfg)
+    plain = ServeEngine(mp, mcfg, slots=2, max_len=64,
+                        prefill_buckets=(16,))
+    spec = ServeEngine(mp, mcfg, slots=2, max_len=64,
+                       prefill_buckets=(16,), draft_params=draft,
+                       draft_cfg=draft_cfg, spec_k=2)
+    p = _prompt(47, 9)
+    rp = plain.submit(p, 8)
+    rs = spec.submit(p, 8)
+    assert spec.run()[rs] == plain.run()[rp]
+
+
 def test_engine_validation():
     with pytest.raises(ValueError, match="slot"):
         ServeEngine(PARAMS, CFG, slots=0)
@@ -154,3 +235,8 @@ def test_engine_validation():
         eng.submit([], 4)
     with pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit(_prompt(14, 8), 0)
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeEngine(PARAMS, CFG, draft_params=PARAMS, draft_cfg=CFG,
+                    spec_k=0)
+    with pytest.raises(ValueError, match="together"):
+        ServeEngine(PARAMS, CFG, draft_params=PARAMS)
