@@ -122,14 +122,29 @@ def main():
     print("swa    :", swa_out[0].tolist())
 
     # speculative decoding: a draft proposes, the target verifies — the
-    # emitted stream is EXACTLY plain greedy's (here self-draft: every
+    # emitted stream is EXACTLY plain greedy's. BATCHED: per-row
+    # acceptance via per-row cache lengths (here self-draft: every
     # proposal accepted, so target calls collapse ~5x)
     from gpu_provisioner_tpu.models.speculative import speculative_generate
     spec_out, stats = speculative_generate(
-        params, params, prompt[:1], cfg, cfg, max_new_tokens=8, spec_k=4)
-    assert (spec_out == greedy[:1, :8]).all()
+        params, params, prompt, cfg, cfg, max_new_tokens=8, spec_k=4)
+    assert (spec_out == greedy[:, :8]).all()
     print(f"spec   : {spec_out[0].tolist()} "
-          f"(target calls: {int(stats['target_calls'])} for 8 tokens)")
+          f"(target calls: {int(stats['target_calls'])} for 8 tokens/row)")
+
+    # continuous batching: a STREAM of ragged requests through slot rows —
+    # each request's tokens equal its solo stream; give the engine a
+    # draft and every step is one speculative round across all slots
+    from gpu_provisioner_tpu.models.engine import ServeEngine
+    eng = ServeEngine(params, cfg, slots=2, max_len=128,
+                      prefill_buckets=(16, 32),
+                      draft_params=params, draft_cfg=cfg, spec_k=3)
+    rids = [eng.submit(prompt[0, :n].tolist(), new)
+            for n, new in ((9, 6), (16, 8), (12, 5))]   # 3 reqs, 2 slots
+    served = eng.run()
+    assert served[rids[1]] == greedy[0, :8].tolist()    # == solo stream
+    print(f"engine : {len(served)} requests served; "
+          f"req1 {served[rids[1]]}")
     print("done")
 
 

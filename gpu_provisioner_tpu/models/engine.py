@@ -154,14 +154,17 @@ class ServeEngine:
 
         self._step = jax.jit(_step, donate_argnums=(2,))
 
-        def _prefill(params, prompt, cache1, pads1):
+        def _prefill_for(pcfg):
             # B=1 general cached forward at offset 0 (left-padded bucket)
-            logits, cache1 = family_fns(cfg, pad_lens=pads1)[1](
-                params, prompt, cache1)
-            lg = logits[:, -1]
-            return lg, cache1
+            # — ONE factory serves target and draft so their prefill
+            # paths cannot diverge
+            def _prefill(params, prompt, cache1, pads1):
+                logits, cache1 = family_fns(pcfg, pad_lens=pads1)[1](
+                    params, prompt, cache1)
+                return logits[:, -1], cache1
+            return jax.jit(_prefill)         # compiles per bucket length
 
-        self._prefill = jax.jit(_prefill)    # compiles per bucket length
+        self._prefill = _prefill_for(cfg)
 
         def _insert(big: KVCache, small: KVCache, slot, length):
             def put(b, s):
@@ -175,7 +178,6 @@ class ServeEngine:
         self._insert = jax.jit(_insert, donate_argnums=(0,))
 
         if draft_cfg is not None:
-            from .decode import family_fns
             from .speculative import spec_round
 
             def _spec_step(params, dparams, last, done, cache_t, cache_d,
@@ -203,12 +205,7 @@ class ServeEngine:
 
             self._spec_step = jax.jit(_spec_step, donate_argnums=(4, 5))
 
-            def _dprefill(dparams, prompt, cache1, pads1):
-                logits, cache1 = family_fns(
-                    draft_cfg, pad_lens=pads1)[1](dparams, prompt, cache1)
-                return logits[:, -1], cache1
-
-            self._dprefill = jax.jit(_dprefill)
+            self._dprefill = _prefill_for(draft_cfg)
             self.draft_cache = init_kv_cache(draft_cfg, slots, max_len)
             self.draft_cache = self.draft_cache._replace(
                 length=jnp.zeros((slots,), jnp.int32))
