@@ -609,6 +609,37 @@ def bench_engine(fast: bool) -> dict:
     out_s = run_spec()
     dt_spec = time.perf_counter() - t0
     total_s = sum(len(v) for v in out_s.values())
+
+    # prefix caching: the same request mix behind a SHARED system prompt,
+    # prefilled once + LRU-reused vs re-prefilled per request
+    PFX = 128 if fast else 512
+    prefix = jax.random.randint(jax.random.key(2), (PFX,), 1,
+                                cfg.vocab_size).tolist()
+    eng_c = ServeEngine(params, cfg, slots=slots, max_len=ML,
+                        prefill_buckets=(64, 128, 256))
+    # fair buckets for the uncached side: same granularity shifted by the
+    # prefix, so the comparison isolates prefix caching (not padding
+    # waste from one coarse bucket)
+    eng_u = ServeEngine(params, cfg, slots=slots, max_len=ML,
+                        prefill_buckets=(PFX + 64, PFX + 128, PFX + 256))
+
+    def run_prefix(eng, cached):
+        for p, n in zip(prompts, news):
+            if cached:
+                eng.submit(p, n, prefix=prefix)
+            else:
+                eng.submit(prefix + p, n)
+        out = dict(eng.run())
+        eng.finished.clear()
+        return out
+
+    run_prefix(eng_c, True), run_prefix(eng_u, False)   # compile
+    t0 = time.perf_counter()
+    run_prefix(eng_c, True)
+    dt_pc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_prefix(eng_u, False)
+    dt_pu = time.perf_counter() - t0
     return {"requests": N, "slots": slots,
             "engine_tokens": total, "engine_ms": dt_engine * 1e3,
             "engine_tokens_per_s": total / dt_engine,
@@ -618,7 +649,11 @@ def bench_engine(fast: bool) -> dict:
             "spec_engine_selfdraft_ms": dt_spec * 1e3,
             "spec_engine_selfdraft_tokens_per_s": total_s / dt_spec,
             "spec_selfdraft_cost_ratio": (total_s / dt_spec)
-                                         / (total / dt_engine)}
+                                         / (total / dt_engine),
+            "prefix_len": PFX,
+            "prefix_cached_ms": dt_pc * 1e3,
+            "prefix_uncached_ms": dt_pu * 1e3,
+            "prefix_cache_speedup": dt_pu / dt_pc}
 
 
 def bench_flash_op(fast: bool) -> dict:

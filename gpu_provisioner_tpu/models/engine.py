@@ -45,7 +45,7 @@ sits on models/decode.py:cached_forward and the per-row-start kernel
 
 from __future__ import annotations
 
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional
@@ -67,6 +67,7 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    prefix: Optional[tuple[int, ...]] = None
 
 
 @dataclass
@@ -94,7 +95,20 @@ class ServeEngine:
     slots emit exactly the plain engine's stream (MoE targets verify
     drop-free); sampled slots draw from the target's filtered
     distribution via rejection sampling. The draft prefills and slots
-    alongside the target (its own cache pool, same buckets/pads)."""
+    alongside the target (its own cache pool, same buckets/pads).
+
+    PREFIX CACHING (``submit(..., prefix=...)``): a shared prompt prefix
+    (system prompt / few-shot header) is prefilled ONCE — left-padded to
+    a bucket like any prompt, so compiles stay bounded by the bucket set
+    — and its cache row LRU-reused by every request that names it.
+    Admission then prefills only the per-request suffix, right-padded to
+    a bucket with the extra writes ROLLED BACK via the cache-length
+    invariant (entries ≥ length are dead); the slot inherits the prefix
+    row's left-pad count, masked by every later step as usual. Dense
+    family only: right-pad garbage rows would compete for MoE routing
+    capacity, so MoE prefixes raise. Cost: one full cache row
+    ([L, 1, Hkv, max_len, Dh]) of HBM per cached prefix
+    (``prefix_cache_size`` bounds it)."""
 
     def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
                  max_len: int = 2048,
@@ -102,7 +116,7 @@ class ServeEngine:
                  temperature: float = 0.0, top_k: int = None,
                  top_p: int = None, key=None,
                  draft_params=None, draft_cfg: LlamaConfig = None,
-                 spec_k: int = 4):
+                 spec_k: int = 4, prefix_cache_size: int = 8):
         _resolve_attn(cfg.attn_impl, cfg.sliding_window,
                       cfg.attn_sinks)        # loud validation, as everywhere
         validate_sampling_args(temperature, top_k, top_p, key)
@@ -166,6 +180,28 @@ class ServeEngine:
 
         self._prefill = _prefill_for(cfg)
 
+        def _suffix_for(pcfg):
+            # prefix caching's suffix continuation: rides at the prefix
+            # row's offset, RIGHT-padded to its bucket; the padded tail's
+            # writes roll back via the length (entries ≥ length are dead
+            # by the cache invariant) and the real last token's logits
+            # come from position r−1. cache1 is the LRU row — never
+            # donated, so the cached prefix row survives every reuse.
+            def _suffix(params, suffix, cache1, pads1, r):
+                # pads1: the PREFIX row's left-pad count (prefixes bucket
+                # through the same left-pad path as prompts, bounding
+                # compiles to the bucket set) — suffix positions and key
+                # masking must keep honoring it
+                logits, cache1 = family_fns(pcfg, pad_lens=pads1)[1](
+                    params, suffix, cache1)
+                lg = jnp.take(logits, r - 1, axis=1)         # [1, V]
+                cache1 = cache1._replace(
+                    length=cache1.length - (suffix.shape[1] - r))
+                return lg, cache1
+            return jax.jit(_suffix)
+
+        self._suffix_prefill = _suffix_for(cfg)
+
         def _insert(big: KVCache, small: KVCache, slot, length):
             def put(b, s):
                 return jax.lax.dynamic_update_slice(
@@ -206,6 +242,7 @@ class ServeEngine:
             self._spec_step = jax.jit(_spec_step, donate_argnums=(4, 5))
 
             self._dprefill = _prefill_for(draft_cfg)
+            self._suffix_prefill_d = _suffix_for(draft_cfg)
             self.draft_cache = init_kv_cache(draft_cfg, slots, max_len)
             self.draft_cache = self.draft_cache._replace(
                 length=jnp.zeros((slots,), jnp.int32))
@@ -219,12 +256,17 @@ class ServeEngine:
         self._queue: deque[Request] = deque()
         self._next_id = 0
         self.finished: dict[int, list[int]] = {}
+        self.prefix_cache_size = prefix_cache_size
+        self._prefix_lru: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.prefix_misses = 0               # observability + tests
 
     # --- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               eos_id: Optional[int] = None) -> int:
-        """Queue a request; returns its id. Raises if it cannot ever fit."""
+               eos_id: Optional[int] = None, prefix=None) -> int:
+        """Queue a request; returns its id. Raises if it cannot ever fit.
+        ``prefix``: shared leading tokens (system prompt) prefilled once
+        and LRU-reused across requests — ``prompt`` continues AFTER it."""
         prompt = list(int(t) for t in prompt)
         if not prompt:
             raise ValueError("empty prompt")
@@ -232,17 +274,33 @@ class ServeEngine:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens} (admission always emits "
                              "the prefill token)")
+        p = 0
+        if prefix is not None:
+            prefix = tuple(int(t) for t in prefix)
+            if not prefix:
+                raise ValueError("empty prefix — omit it instead")
+            from .moe import MoEConfig
+            if isinstance(self.cfg, MoEConfig) or \
+                    isinstance(self.draft_cfg, MoEConfig):
+                raise ValueError(
+                    "prefix caching serves the dense family only — the "
+                    "right-padded suffix rows would compete for MoE "
+                    "routing capacity")
+            p = self._bucket(len(prefix))   # prefixes bucket like prompts
         b = self._bucket(len(prompt))
-        if b + max_new_tokens + self._slack > self.max_len:
+        if p + b + max_new_tokens + self._slack > self.max_len:
             # speculative engines add verify slack: a round may write
             # spec_k+1 entries at the row's current length
             raise ValueError(
-                f"request needs bucket {b} + {max_new_tokens} new tokens "
+                f"request needs "
+                + (f"prefix {p} + " if p else "")
+                + f"bucket {b} + {max_new_tokens} new tokens "
                 + (f"+ {self._slack} verify slack " if self._slack else "")
                 + f"> max_len {self.max_len}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(Request(rid, prompt, max_new_tokens, eos_id))
+        self._queue.append(Request(rid, prompt, max_new_tokens, eos_id,
+                                   prefix))
         return rid
 
     def _bucket(self, n: int) -> int:
@@ -261,12 +319,23 @@ class ServeEngine:
             if self._slot[s] is not None:
                 continue
             req = self._queue.popleft()
-            b = self._bucket(len(req.prompt))
-            pad = b - len(req.prompt)
-            prompt = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
-            cache1 = init_kv_cache(self.cfg, 1, self.max_len)
-            lg, cache1 = self._prefill(self.params, prompt, cache1,
-                                       jnp.asarray([pad], jnp.int32))
+            if req.prefix is not None:
+                lg, cache1, dcache1, pad, length = self._prefix_admit(req)
+            else:
+                b = self._bucket(len(req.prompt))
+                pad = b - len(req.prompt)
+                length = b
+                prompt = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
+                cache1 = init_kv_cache(self.cfg, 1, self.max_len)
+                lg, cache1 = self._prefill(self.params, prompt, cache1,
+                                           jnp.asarray([pad], jnp.int32))
+                dcache1 = None
+                if self.draft_cfg is not None:
+                    dcache1 = init_kv_cache(self.draft_cfg, 1,
+                                            self.max_len)
+                    _, dcache1 = self._dprefill(
+                        self.draft_params, prompt, dcache1,
+                        jnp.asarray([pad], jnp.int32))
             if self.temperature > 0:
                 self._key, k0 = jax.random.split(self._key)
                 tok0 = jax.random.categorical(
@@ -277,20 +346,62 @@ class ServeEngine:
             tok0 = int(tok0[0])
             self.cache = self._insert(self.cache, cache1,
                                       jnp.asarray(s, jnp.int32),
-                                      jnp.asarray(b, jnp.int32))
-            if self.draft_cfg is not None:
-                dcache1 = init_kv_cache(self.draft_cfg, 1, self.max_len)
-                _, dcache1 = self._dprefill(
-                    self.draft_params, prompt, dcache1,
-                    jnp.asarray([pad], jnp.int32))
+                                      jnp.asarray(length, jnp.int32))
+            if dcache1 is not None:
                 self.draft_cache = self._insert(
                     self.draft_cache, dcache1, jnp.asarray(s, jnp.int32),
-                    jnp.asarray(b, jnp.int32))
+                    jnp.asarray(length, jnp.int32))
             self._pads = self._pads.at[s].set(pad)
             self._last = self._last.at[s].set(tok0)
             self._slot[s] = _Slot(req, [tok0])
             emitted.setdefault(req.req_id, []).append(tok0)
             self._maybe_finish(s)
+
+    def _prefix_row(self, prefix: tuple[int, ...]):
+        """(target row cache, draft row cache | None, pad count) prefilled
+        over the LEFT-pad-bucketed prefix, LRU-cached — the prefill cost
+        is paid once per distinct prefix, every later request reuses the
+        row, and bucketing keeps the compile count bounded by the bucket
+        set (an exact-length prefill would compile per distinct length)."""
+        hit = self._prefix_lru.get(prefix)
+        if hit is not None:
+            self._prefix_lru.move_to_end(prefix)
+            return hit
+        self.prefix_misses += 1
+        pb = self._bucket(len(prefix))
+        pad = pb - len(prefix)
+        toks = jnp.asarray([[0] * pad + list(prefix)], jnp.int32)
+        pads1 = jnp.asarray([pad], jnp.int32)
+        c = init_kv_cache(self.cfg, 1, self.max_len)
+        _, c = self._prefill(self.params, toks, c, pads1)
+        d = None
+        if self.draft_cfg is not None:
+            d = init_kv_cache(self.draft_cfg, 1, self.max_len)
+            _, d = self._dprefill(self.draft_params, toks, d, pads1)
+        self._prefix_lru[prefix] = (c, d, pad)
+        while len(self._prefix_lru) > self.prefix_cache_size:
+            self._prefix_lru.popitem(last=False)
+        return c, d, pad
+
+    def _prefix_admit(self, req: Request):
+        """Admission via a cached prefix row: only the per-request suffix
+        is prefilled, RIGHT-padded to a bucket — the padded tail's writes
+        roll back via the length. The slot inherits the prefix row's
+        LEFT-pad count, which every later step keeps masking."""
+        b = self._bucket(len(req.prompt))
+        suffix = jnp.asarray(
+            [req.prompt + [0] * (b - len(req.prompt))], jnp.int32)
+        r = jnp.asarray(len(req.prompt), jnp.int32)
+        pc, pd, pad = self._prefix_row(req.prefix)
+        pads1 = jnp.asarray([pad], jnp.int32)
+        lg, cache1 = self._suffix_prefill(self.params, suffix, pc, pads1,
+                                          r)
+        dcache1 = None
+        if self.draft_cfg is not None:
+            _, dcache1 = self._suffix_prefill_d(self.draft_params, suffix,
+                                                pd, pads1, r)
+        length = self._bucket(len(req.prefix)) + len(req.prompt)
+        return lg, cache1, dcache1, pad, length
 
     def _maybe_finish(self, s: int) -> None:
         slot = self._slot[s]

@@ -220,6 +220,91 @@ def test_engine_speculative_moe_target():
     assert spec.run()[rs] == plain.run()[rp]
 
 
+def test_engine_prefix_caching_exact_and_lru():
+    """Shared-prefix requests: the prefix prefills ONCE (LRU), each
+    request's suffix continues it right-padded — streams equal solo
+    generate() on prefix+prompt exactly; eviction works."""
+    prefix = _prompt(50, 11)
+    eng = ServeEngine(PARAMS, CFG, slots=2, max_len=96,
+                      prefill_buckets=(16,), prefix_cache_size=2)
+    reqs = {}
+    for i in range(4):                       # 4 requests, one prefix
+        p = _prompt(51 + i, 7 + i)
+        reqs[eng.submit(p, 6, prefix=prefix)] = p
+    out = eng.run()
+    assert eng.prefix_misses == 1            # prefilled once, reused 3×
+    for rid, p in reqs.items():
+        assert out[rid] == _solo(prefix + p, 6), f"req {rid}"
+    # a second prefix shares the cache; a third evicts the LRU entry
+    for j, extra in enumerate((_prompt(60, 9), _prompt(61, 13))):
+        eng.submit(_prompt(62 + j, 7), 4, prefix=extra)
+    eng.run()
+    assert eng.prefix_misses == 3
+    assert len(eng._prefix_lru) == 2         # size bound enforced
+    # the evicted first prefix re-prefills on next use
+    r = eng.submit(_prompt(64, 7), 4, prefix=prefix)
+    out2 = eng.run()
+    assert eng.prefix_misses == 4
+    assert out2[r] == _solo(prefix + _prompt(64, 7), 4)
+
+
+def test_engine_prefix_with_int8_cache():
+    """Prefix rows quantize too: the int8 prefix cache row carries its
+    scales through insert/suffix/decode — stream equals solo int8."""
+    cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    prefix = _prompt(55, 11)
+    eng = ServeEngine(PARAMS, cfg8, slots=1, max_len=96,
+                      prefill_buckets=(16,))
+    p = _prompt(56, 8)
+    rid = eng.submit(p, 6, prefix=prefix)
+    out = eng.run()
+    # solo reference at the SAME padding (prefix buckets to 16 with 5
+    # left pads → int8 scales quantize identical values either way, but
+    # keep the reference shape-identical for strictness)
+    padded = jnp.asarray([[0] * 5 + prefix + p], jnp.int32)
+    want = generate(PARAMS, padded, cfg8, max_new_tokens=6, max_len=96,
+                    pad_id=0)
+    assert out[rid] == [int(t) for t in want[0]]
+
+
+def test_engine_prefix_with_speculation():
+    """Prefix caching composes with the speculative engine: both caches
+    carry the prefix row and the streams stay exactly plain greedy's."""
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(jax.random.key(3), draft_cfg)
+    prefix = _prompt(70, 10)
+    eng = ServeEngine(PARAMS, CFG, slots=2, max_len=96,
+                      prefill_buckets=(16,), draft_params=draft,
+                      draft_cfg=draft_cfg, spec_k=3)
+    reqs = {eng.submit(_prompt(71 + i, 8), 6, prefix=prefix): i
+            for i in range(3)}
+    out = eng.run()
+    assert eng.prefix_misses == 1
+    for rid, i in reqs.items():
+        assert out[rid] == _solo(prefix + _prompt(71 + i, 8), 6)
+
+
+def test_engine_prefix_validation():
+    from gpu_provisioner_tpu.models.moe import MoEConfig, init_moe_model
+
+    eng = ServeEngine(PARAMS, CFG, slots=1, max_len=64,
+                      prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="empty prefix"):
+        eng.submit(_prompt(80, 8), 4, prefix=[])
+    with pytest.raises(ValueError, match="prefix 16"):
+        # prefix buckets to 16: 16 + 16 + 40 > 64
+        eng.submit(_prompt(80, 8), 40, prefix=_prompt(81, 10))
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit(_prompt(80, 8), 4, prefix=_prompt(81, 40))  # no bucket
+    mcfg = MoEConfig(vocab_size=128, dim=64, n_layers=1, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     n_experts=4, experts_per_token=2, dtype="float32")
+    meng = ServeEngine(init_moe_model(jax.random.key(1), mcfg), mcfg,
+                       slots=1, max_len=64, prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="dense family"):
+        meng.submit(_prompt(82, 8), 4, prefix=_prompt(83, 8))
+
+
 def test_engine_validation():
     with pytest.raises(ValueError, match="slot"):
         ServeEngine(PARAMS, CFG, slots=0)
