@@ -616,7 +616,7 @@ def bench_engine(fast: bool) -> dict:
     prefix = jax.random.randint(jax.random.key(2), (PFX,), 1,
                                 cfg.vocab_size).tolist()
     eng_c = ServeEngine(params, cfg, slots=slots, max_len=ML,
-                        prefill_buckets=(64, 128, 256))
+                        prefill_buckets=(64, 128, 256, PFX))
     # fair buckets for the uncached side: same granularity shifted by the
     # prefix, so the comparison isolates prefix caching (not padding
     # waste from one coarse bucket)

@@ -23,11 +23,19 @@ from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
 from .slicegroup import SliceGroupController, group_requests
 from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
+from .utils import shard_owns
+
+
+def _node_pool(node: Node) -> Optional[str]:
+    """The claim/pool name a Node correlates (and shards) under — ONE
+    home for the label precedence so lifecycle mapping and shard
+    partitioning can never disagree about a node's owner."""
+    return (node.metadata.labels.get(wk.TPU_SLICE_ID_LABEL)
+            or node.metadata.labels.get(wk.GKE_NODEPOOL_LABEL))
 
 
 def node_to_nodeclaim_requests(node: Node) -> list[Request]:
-    pool = (node.metadata.labels.get(wk.TPU_SLICE_ID_LABEL)
-            or node.metadata.labels.get(wk.GKE_NODEPOOL_LABEL))
+    pool = _node_pool(node)
     return [Request(name=pool)] if pool else []
 
 
@@ -40,39 +48,73 @@ def build_controllers(client: Client, cloudprovider,
                       node_repair: bool = True,
                       max_concurrent_reconciles: int = 64,
                       cluster: str = "kaito",
+                      shards: int = 1, shard_index: int = 0,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
     lifecycle/controller.go:56-58,89 — asyncio workers are cheap but bounded
-    for fairness)."""
+    for fairness).
+
+    ``shards``/``shard_index``: claim-shard horizontal scaling past the
+    single-event-loop ceiling (shard_owns): per-claim controllers
+    (lifecycle, termination, health) enqueue only objects whose claim/pool
+    name hashes to this shard — filtering at the WATCH→request boundary,
+    so foreign objects never occupy a worker; cluster-scoped singletons
+    (both GC directions, slice-group assignment) run on shard 0 only.
+    Every shard watches the full stream (the apiserver fans out watches
+    anyway); the partition costs one crc32 per event. Nodes without a
+    pool label fall to shard 0 so nothing is orphaned."""
+    if not 0 <= shard_index < shards:
+        raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
+    owns = (lambda name: True) if shards == 1 else \
+        (lambda name: shard_owns(name, shards, shard_index))
+
+    def claim_map(nc) -> list[Request]:
+        name = nc.metadata.name
+        return [Request(name=name)] if owns(name) else []
+
+    def node_claim_map(node: Node) -> list[Request]:
+        return [r for r in node_to_nodeclaim_requests(node)
+                if owns(r.name)]
+
+    def node_map(node: Node) -> list[Request]:
+        key = _node_pool(node)
+        mine = owns(key) if key else shard_index == 0
+        return [Request(name=node.metadata.name)] if mine else []
+
     lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
                                             lifecycle_options)
     eviction = EvictionQueue(client, recorder=recorder)
     termination = NodeTerminationController(client, cloudprovider, eviction,
                                             recorder, termination_options)
-    instance_gc = InstanceGCController(client, cloudprovider, gc_options)
-    nodeclaim_gc = NodeClaimGCController(client, cloudprovider, gc_options)
 
     controllers = [
         Controller(lifecycle.NAME, lifecycle,
                    max_concurrent=max_concurrent_reconciles)
-        .watches(NodeClaim)
-        .watches(Node, map_fn=node_to_nodeclaim_requests),
+        .watches(NodeClaim, map_fn=claim_map)
+        .watches(Node, map_fn=node_claim_map),
         Controller(termination.NAME, termination, max_concurrent=16)
-        .watches(Node),
-        Controller(instance_gc.NAME, Singleton(instance_gc.run_once),
-                   max_concurrent=1).as_singleton(),
-        Controller(nodeclaim_gc.NAME, Singleton(nodeclaim_gc.run_once),
-                   max_concurrent=1).as_singleton(),
-        Controller(SliceGroupController.NAME,
-                   SliceGroupController(client, cluster=cluster),
-                   max_concurrent=4)
-        .watches(Node, map_fn=group_requests)
-        .watches(NodeClaim, map_fn=group_requests),
+        .watches(Node, map_fn=node_map),
     ]
+    if shard_index == 0:
+        instance_gc = InstanceGCController(client, cloudprovider, gc_options)
+        nodeclaim_gc = NodeClaimGCController(client, cloudprovider,
+                                             gc_options)
+        controllers += [
+            Controller(instance_gc.NAME, Singleton(instance_gc.run_once),
+                       max_concurrent=1).as_singleton(),
+            Controller(nodeclaim_gc.NAME, Singleton(nodeclaim_gc.run_once),
+                       max_concurrent=1).as_singleton(),
+            Controller(SliceGroupController.NAME,
+                       SliceGroupController(client, cluster=cluster),
+                       max_concurrent=4)
+            .watches(Node, map_fn=group_requests)
+            .watches(NodeClaim, map_fn=group_requests),
+        ]
     # Node health only with repair policies + gate (controllers.go:110-113).
     if node_repair and cloudprovider.repair_policies():
         health = NodeHealthController(client, cloudprovider, recorder, health_options)
         controllers.append(
-            Controller(health.NAME, health, max_concurrent=8).watches(Node))
+            Controller(health.NAME, health, max_concurrent=8)
+            .watches(Node, map_fn=node_map))
     return controllers, eviction

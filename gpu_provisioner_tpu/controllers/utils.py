@@ -33,6 +33,19 @@ async def list_managed(client: Client) -> list[NodeClaim]:
     return [nc for nc in await client.list(NodeClaim) if is_managed(nc)]
 
 
+def shard_owns(name: str, shards: int, shard_index: int) -> bool:
+    """Claim-shard ownership: stable name-hash partitioning of the
+    reconcile workload across operator replicas. The single asyncio event
+    loop is the documented throughput ceiling above ~2048 concurrent
+    claims (BENCH_NOTES_r04/r05); N shards run N processes, each owning
+    the claims (and their nodes, keyed by pool name == claim name) whose
+    crc32 lands on its index. crc32 is stable across processes and
+    platforms — every replica computes the same partition independently,
+    no coordination required."""
+    import zlib
+    return zlib.crc32(name.encode()) % shards == shard_index
+
+
 async def slice_nodes(client: Client, nodeclaim_name: str) -> list[Node]:
     """All Node objects of a NodeClaim's slice, correlated by the GKE
     node-pool label (the analog of getNodesByName's agentpool-label match,

@@ -50,6 +50,11 @@ class EnvtestOptions:
     repair_toleration: float = 30.0
     repair_max_unhealthy_fraction: float = 0.0
     max_concurrent_reconciles: int = 64
+    # Claim-shard partitioning (controllers/registry.py): an Env built with
+    # shards>1 runs ONE shard's controller set — partition tests assert a
+    # shard only reconciles its own claims.
+    shards: int = 1
+    shard_index: int = 0
     # Layer the informer cache between controllers/provider and the store,
     # as the real operator wires it (__main__.py) — bench.py turns this on
     # so fleet-scale runs exercise (and size) the cache; unit tests keep the
@@ -94,7 +99,8 @@ class Env:
                                  leak_grace=self.opts.leak_grace),
             health_options=HealthOptions(
                 max_unhealthy_fraction=self.opts.repair_max_unhealthy_fraction),
-            max_concurrent_reconciles=self.opts.max_concurrent_reconciles)
+            max_concurrent_reconciles=self.opts.max_concurrent_reconciles,
+            shards=self.opts.shards, shard_index=self.opts.shard_index)
         self.manager = Manager(self.client).register(*controllers)
 
     async def __aenter__(self) -> "Env":

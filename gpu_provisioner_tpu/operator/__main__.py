@@ -39,6 +39,8 @@ async def run_simulate(opts) -> int:
     env_opts.lifecycle.termination_requeue = opts.termination_requeue_seconds
     env_opts.termination.instance_requeue = opts.instance_requeue_seconds
     env_opts.max_concurrent_reconciles = opts.max_concurrent_reconciles
+    env_opts.shards = opts.shards
+    env_opts.shard_index = opts.shard_index
 
     async with Env(env_opts) as env:
         runners = await start_servers(env.manager, opts.metrics_port,
@@ -172,14 +174,20 @@ async def run_real(opts) -> int:
             max_unhealthy_fraction=opts.repair_max_unhealthy_fraction),
         max_concurrent_reconciles=opts.max_concurrent_reconciles,
         node_repair=opts.feature_gates.node_repair,
-        cluster=cfg.cluster_name)
+        cluster=cfg.cluster_name,
+        shards=opts.shards, shard_index=opts.shard_index)
     manager = Manager(kube).register(*controllers)
 
     stop = asyncio.Event()
     elector = None
     if not opts.disable_leader_election:  # default OFF (options.go:117)
         from ..runtime.leaderelection import LeaderElector
-        elector = LeaderElector(kube, namespace=conn.namespace,
+        # per-shard lease: shards are active-active ACROSS indices,
+        # active-passive within one (N replicas per shard still fail over)
+        lease = ("tpu-provisioner" if opts.shards == 1
+                 else f"tpu-provisioner-shard-{opts.shard_index}")
+        elector = LeaderElector(kube, lease_name=lease,
+                                namespace=conn.namespace,
                                 on_lost=stop.set)
         log.info("waiting for leadership",
                  extra={"identity": elector.identity})

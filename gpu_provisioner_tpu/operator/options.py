@@ -43,6 +43,14 @@ class Options:
     # delete of expensive capacity.
     repair_max_unhealthy_fraction: float = 0.0
     max_concurrent_reconciles: int = 64
+    # Claim-shard horizontal scaling (controllers/registry.py): run N
+    # replicas, each with a distinct SHARD_INDEX; per-claim work partitions
+    # by name hash, cluster singletons (GC, slice groups) stay on shard 0,
+    # and each shard's leader-election lease is suffixed -shard-{i} so
+    # shards are active-active while replicas WITHIN a shard stay
+    # active-passive.
+    shards: int = 1
+    shard_index: int = 0
     simulate: bool = False
     simulate_claims: int = 0
     simulate_shape: str = "tpu-v5e-8"
@@ -87,6 +95,8 @@ def parse_options(argv=None, env=None) -> Options:
         repair_max_unhealthy_fraction=float(
             e.get("REPAIR_MAX_UNHEALTHY_FRACTION", "0")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
+        shards=int(e.get("SHARDS", "1")),
+        shard_index=int(e.get("SHARD_INDEX", "0")),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)
 
@@ -97,6 +107,8 @@ def parse_options(argv=None, env=None) -> Options:
     p.add_argument("--enable-profiling", action="store_true",
                    default=o.enable_profiling)
     p.add_argument("--feature-gates", default="")
+    p.add_argument("--shards", type=int, default=o.shards)
+    p.add_argument("--shard-index", type=int, default=o.shard_index)
     p.add_argument("--simulate", action="store_true",
                    help="run against the in-process simulated cloud (envtest)")
     p.add_argument("--simulate-claims", type=int, default=0,
@@ -109,6 +121,10 @@ def parse_options(argv=None, env=None) -> Options:
     o.log_level = args.log_level
     o.enable_profiling = args.enable_profiling
     o.feature_gates = parse_feature_gates(args.feature_gates, o.feature_gates)
+    o.shards = args.shards
+    o.shard_index = args.shard_index
+    if not 0 <= o.shard_index < o.shards:
+        p.error(f"--shard-index {o.shard_index} outside [0, {o.shards})")
     o.simulate = args.simulate
     o.simulate_claims = args.simulate_claims
     o.simulate_shape = args.simulate_shape

@@ -182,6 +182,41 @@ async def test_gc_holds_off_on_stale_informer_cache():
             await asyncio.sleep(0.05)
 
 
+@async_test
+async def test_shard_partition_only_reconciles_owned_claims():
+    """Claim-shard scaling (registry.py shards/shard_index): a shard's
+    controllers reconcile ONLY claims whose name hashes to it — foreign
+    claims never enqueue, so N processes partition the fleet without
+    coordination. GC singletons run on shard 0 only."""
+    from gpu_provisioner_tpu.controllers.utils import shard_owns
+
+    # find names deterministically on each side of a 2-way split
+    mine = [f"sh{i}" for i in range(40) if shard_owns(f"sh{i}", 2, 0)][:2]
+    theirs = [f"sh{i}" for i in range(40)
+              if not shard_owns(f"sh{i}", 2, 0)][:2]
+    assert len(mine) == 2 and len(theirs) == 2
+
+    async with Env(EnvtestOptions(shards=2, shard_index=0)) as env:
+        for n in mine + theirs:
+            await env.client.create(make_nodeclaim(n))
+        for n in mine:
+            await env.wait_ready(n)
+        # foreign claims: untouched — no Launched condition, no pool
+        for n in theirs:
+            nc = await env.client.get(NodeClaim, n)
+            assert nc.status_conditions.get("Launched") is None, n
+            assert n not in env.cloud.nodepools.pools
+    # the complementary shard picks up exactly the other half
+    async with Env(EnvtestOptions(shards=2, shard_index=1)) as env:
+        for n in mine + theirs:
+            await env.client.create(make_nodeclaim(n))
+        for n in theirs:
+            await env.wait_ready(n)
+        for n in mine:
+            nc = await env.client.get(NodeClaim, n)
+            assert nc.status_conditions.get("Launched") is None, n
+
+
 def test_health_refuses_repair_on_stale_cache_unit():
     from gpu_provisioner_tpu.controllers.health import (HealthOptions,
                                                         NodeHealthController)
