@@ -53,17 +53,25 @@ async def run_simulate(opts) -> int:
         watcher = asyncio.create_task(_log_transitions(env))
         try:
             if opts.simulate_claims > 0:
-                for i in range(opts.simulate_claims):
+                from ..controllers.utils import shard_owns
+                names = [f"sim{i}" for i in range(opts.simulate_claims)]
+                for i, name in enumerate(names):
                     await env.client.create(make_nodeclaim(
-                        f"sim{i}", opts.simulate_shape, workspace=f"ws{i}"))
-                for i in range(opts.simulate_claims):
-                    nc = await env.wait_ready(f"sim{i}", timeout=120)
+                        name, opts.simulate_shape, workspace=f"ws{i}"))
+                # a sharded simulate run only reconciles its own claims —
+                # waiting on foreign ones would time out by design
+                owned = [n for n in names
+                         if opts.shards == 1
+                         or shard_owns(n, opts.shards, opts.shard_index)]
+                for name in owned:
+                    nc = await env.wait_ready(name, timeout=120)
                     log.info("nodeclaim ready", extra={
                         "nodeclaim": nc.metadata.name,
                         "providerID": nc.status.provider_id,
                         "topology": nc.metadata.labels.get(wk.TPU_TOPOLOGY_LABEL)})
-                log.info("all claims ready; exiting",
-                         extra={"count": opts.simulate_claims})
+                log.info("all owned claims ready; exiting",
+                         extra={"count": len(owned),
+                                "claims_created": len(names)})
                 return 0
             await asyncio.Event().wait()
             return 0

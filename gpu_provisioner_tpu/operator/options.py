@@ -61,6 +61,22 @@ def _env_bool(e, key: str, default: bool) -> bool:
     return default if raw == "" else raw in ("1", "true", "yes")
 
 
+def _shard_index_env(e) -> int:
+    """SHARD_INDEX with a named failure for the chart's fieldRef source:
+    on Kubernetes < 1.28 the apps.kubernetes.io/pod-index label doesn't
+    exist and the downward API resolves it to an EMPTY string — int('')
+    would crash-loop with a cryptic traceback; name the requirement
+    instead."""
+    raw = e.get("SHARD_INDEX", "0").strip()
+    if "SHARD_INDEX" in e and raw == "":
+        raise SystemExit(
+            "SHARD_INDEX is set but empty — the chart sources it from the "
+            "pod-ordinal label (apps.kubernetes.io/pod-index), which "
+            "requires Kubernetes >= 1.28; on older clusters set "
+            "SHARD_INDEX explicitly per replica")
+    return int(raw or "0")
+
+
 def parse_feature_gates(raw: str, base: FeatureGates) -> FeatureGates:
     """Parse "NodeRepair=true,Other=false" (options.go:177-204)."""
     for part in raw.split(","):
@@ -96,7 +112,7 @@ def parse_options(argv=None, env=None) -> Options:
             e.get("REPAIR_MAX_UNHEALTHY_FRACTION", "0")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
         shards=int(e.get("SHARDS", "1")),
-        shard_index=int(e.get("SHARD_INDEX", "0")),
+        shard_index=_shard_index_env(e),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)
 

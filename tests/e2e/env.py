@@ -129,6 +129,7 @@ class Environment:
         self.nodepools = None  # node-pool assertion seam, both targets
         self._log_task = None
         self.logs: list[str] = []
+        self._extra: list[tuple] = []   # (proc, pump) of extra replicas
         if self.real:
             return
         self.backing = InMemoryClient()
@@ -156,36 +157,9 @@ class Environment:
             "users": [{"name": "e2e", "user": {"token": "e2e-token"}}],
         }))
 
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        env = {**os.environ,
-               # The operator is control-plane only — never imports jax.
-               # Site hooks (axon sitecustomize) preload jax + a PJRT
-               # plugin into every interpreter when this var is set, which
-               # added seconds of startup and caused readiness-timeout
-               # flakes when specs shared the box with JAX-compiling tests.
-               "PALLAS_AXON_POOL_IPS": "",
-               "PYTHONPATH": repo_root + os.pathsep
-               + os.environ.get("PYTHONPATH", ""),
-               "KUBECONFIG": str(kubeconfig),
-               "KUBERNETES_SERVICE_HOST": "",   # force kubeconfig path
-               "PROJECT_ID": "test-project", "LOCATION": "us-central2-b",
-               "CLUSTER_NAME": "kaito",
-               "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "e2e-token",
-               "GKE_API_ENDPOINT": f"{gcp_url}/v1",
-               "TPU_API_ENDPOINT": f"{gcp_url}/v2",
-               "METRICS_PORT": str(self.metrics_port),
-               "HEALTH_PROBE_PORT": str(self.health_port),
-               "GC_INTERVAL_SECONDS": str(self.gc_interval),
-               "GC_LEAK_GRACE_SECONDS": str(self.leak_grace),
-               "TERMINATION_REQUEUE_SECONDS": "0.2",
-               "INSTANCE_REQUEUE_SECONDS": "0.2",
-               "LOG_LEVEL": "debug",
-               **self.extra_env}
-        self.proc = await asyncio.create_subprocess_exec(
-            sys.executable, "-m", "gpu_provisioner_tpu.operator", env=env,
-            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
-        self._log_task = asyncio.create_task(self._pump_logs())
+        self.proc = await self.spawn_operator()
+        self._log_task = asyncio.create_task(
+            self._pump_logs(self.proc))
 
         self.client = RestClient(
             KubeConnection(server=kube_url, token="e2e-token"),
@@ -194,6 +168,63 @@ class Environment:
         self.nodepools = self.cloud.nodepools
         await self._await_ready()
         return self
+
+    def subprocess_env(self, *, metrics_port: Optional[int] = None,
+                       health_port: Optional[int] = None,
+                       extra: Optional[dict] = None) -> dict:
+        """The operator-subprocess environment — ONE home for every
+        setting so the primary operator and any extra replica a spec
+        launches (e.g. shard peers) can never drift onto different
+        timing configs."""
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        return {**os.environ,
+                # The operator is control-plane only — never imports jax.
+                # Site hooks (axon sitecustomize) preload jax + a PJRT
+                # plugin into every interpreter when this var is set,
+                # which added seconds of startup and caused
+                # readiness-timeout flakes when specs shared the box with
+                # JAX-compiling tests.
+                "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": repo_root + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+                "KUBECONFIG": str(self.tmp_path / "kubeconfig"),
+                "KUBERNETES_SERVICE_HOST": "",   # force kubeconfig path
+                "PROJECT_ID": "test-project", "LOCATION": "us-central2-b",
+                "CLUSTER_NAME": "kaito",
+                "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "e2e-token",
+                "GKE_API_ENDPOINT": f"{self.gcp_url}/v1",
+                "TPU_API_ENDPOINT": f"{self.gcp_url}/v2",
+                "METRICS_PORT": str(metrics_port or self.metrics_port),
+                "HEALTH_PROBE_PORT": str(health_port or self.health_port),
+                "GC_INTERVAL_SECONDS": str(self.gc_interval),
+                "GC_LEAK_GRACE_SECONDS": str(self.leak_grace),
+                "TERMINATION_REQUEUE_SECONDS": "0.2",
+                "INSTANCE_REQUEUE_SECONDS": "0.2",
+                "LOG_LEVEL": "debug",
+                **self.extra_env,
+                **(extra or {})}
+
+    async def spawn_operator(self, extra: Optional[dict] = None):
+        """Launch an operator subprocess against this Environment's fakes.
+        With ``extra`` (e.g. a shard peer's SHARD_INDEX) the replica gets
+        its own ports and its logs pump into self.logs tagged by index —
+        an undrained debug-level pipe would otherwise fill and block the
+        child. Extra replicas are torn down in __aexit__."""
+        if extra is None:
+            env = self.subprocess_env()
+        else:
+            env = self.subprocess_env(metrics_port=_free_port(),
+                                      health_port=_free_port(),
+                                      extra=extra)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "gpu_provisioner_tpu.operator", env=env,
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+        if extra is not None:
+            tag = f"[replica{len(self._extra)}] "
+            self._extra.append(
+                (proc, asyncio.create_task(self._pump_logs(proc, tag))))
+        return proc
 
     async def _enter_real(self) -> "Environment":
         """Target a live cluster: kubeconfig client + production GKE client;
@@ -205,10 +236,10 @@ class Environment:
         await discovery_teardown(self.client, self.eventually,
                                  DEFAULT_TIMEOUT)
 
-    async def _pump_logs(self) -> None:
-        assert self.proc and self.proc.stdout
-        async for line in self.proc.stdout:
-            self.logs.append(line.decode(errors="replace").rstrip())
+    async def _pump_logs(self, proc, tag: str = "") -> None:
+        assert proc and proc.stdout
+        async for line in proc.stdout:
+            self.logs.append(tag + line.decode(errors="replace").rstrip())
 
     async def _await_ready(self) -> None:
         async with httpx.AsyncClient() as http:
@@ -239,13 +270,16 @@ class Environment:
                 if self.nodepools is not None:
                     await self.nodepools.aclose()
             return
-        if self.proc and self.proc.returncode is None:
-            self.proc.terminate()
-            try:
-                await asyncio.wait_for(self.proc.wait(), 10)
-            except asyncio.TimeoutError:
-                self.proc.kill()
-                await self.proc.wait()
+        for proc, _pump in [(self.proc, self._log_task)] + self._extra:
+            if proc and proc.returncode is None:
+                proc.terminate()
+                try:
+                    await asyncio.wait_for(proc.wait(), 10)
+                except asyncio.TimeoutError:
+                    proc.kill()
+                    await proc.wait()
+        for _proc, pump in self._extra:
+            pump.cancel()
         if self._log_task:
             self._log_task.cancel()
         if self.client:

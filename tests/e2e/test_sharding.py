@@ -5,14 +5,11 @@ ceiling above ~2048 concurrent claims; claim-shard scaling (SHARDS /
 SHARD_INDEX, controllers/registry.py) runs N operator replicas that
 partition per-claim work by name hash with no coordination. This spec
 boots shard 0 through the standard Environment and shard 1 as a second
-REAL operator subprocess against the same apiserver/GCP fakes, then
-provisions claims landing on BOTH shards — everything must go Ready, and
-the partition must be real (each claim hashes to exactly one shard).
+REAL operator replica (same fakes, same timing config — spawn_operator
+shares the env construction), then provisions claims landing on BOTH
+shards — everything must go Ready, and the partition must be real (each
+claim hashes to exactly one shard, each pool created exactly once).
 """
-
-import asyncio
-import os
-import sys
 
 import pytest
 
@@ -20,7 +17,7 @@ from gpu_provisioner_tpu.controllers.utils import shard_owns
 from gpu_provisioner_tpu.fake import make_nodeclaim
 
 from ..conftest import async_test_long as async_test
-from .env import Environment, _free_port, fake_only
+from .env import Environment, fake_only
 
 pytestmark = pytest.mark.e2e
 
@@ -31,50 +28,20 @@ async def test_two_shards_cover_the_fleet(tmp_path):
     async with Environment(tmp_path,
                            extra_env={"SHARDS": "2",
                                       "SHARD_INDEX": "0"}) as env:
-        # shard 1: a second operator process, same fakes, own ports
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        env2 = {**os.environ,
-                "PALLAS_AXON_POOL_IPS": "",
-                "PYTHONPATH": repo_root + os.pathsep
-                + os.environ.get("PYTHONPATH", ""),
-                "KUBECONFIG": str(tmp_path / "kubeconfig"),
-                "KUBERNETES_SERVICE_HOST": "",
-                "PROJECT_ID": "test-project",
-                "LOCATION": "us-central2-b", "CLUSTER_NAME": "kaito",
-                "E2E_TEST_MODE": "true", "E2E_STATIC_TOKEN": "e2e-token",
-                "GKE_API_ENDPOINT": f"{env.gcp_url}/v1",
-                "TPU_API_ENDPOINT": f"{env.gcp_url}/v2",
-                "METRICS_PORT": str(_free_port()),
-                "HEALTH_PROBE_PORT": str(_free_port()),
-                "SHARDS": "2", "SHARD_INDEX": "1",
-                "LOG_LEVEL": "debug"}
-        proc = await asyncio.create_subprocess_exec(
-            sys.executable, "-m", "gpu_provisioner_tpu.operator", env=env2,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.STDOUT)
-        try:
-            # claims spanning both shards, found deterministically
-            names = []
-            for idx in (0, 0, 1, 1):
-                names.append(next(
-                    f"cl{i}" for i in range(100)
-                    if shard_owns(f"cl{i}", 2, idx)
-                    and f"cl{i}" not in names))
-            assert {shard_owns(n, 2, 0) for n in names} == {True, False}
-            for n in names:
-                await env.client.create(make_nodeclaim(n))
-            for n in names:
-                await env.expect_nodeclaim_ready(n)
-            # the partition was load-bearing: every pool exists exactly
-            # once (no double-create from overlapping reconciles)
-            pools = [p.name for p in await env.kaito_pools()]
-            assert sorted(pools) == sorted(names)
-        finally:
-            if proc.returncode is None:
-                proc.terminate()
-                try:
-                    await asyncio.wait_for(proc.wait(), 10)
-                except asyncio.TimeoutError:
-                    proc.kill()
-                    await proc.wait()
+        await env.spawn_operator({"SHARD_INDEX": "1"})
+        # claims spanning both shards, found deterministically
+        names = []
+        for idx in (0, 0, 1, 1):
+            names.append(next(
+                f"cl{i}" for i in range(100)
+                if shard_owns(f"cl{i}", 2, idx)
+                and f"cl{i}" not in names))
+        assert {shard_owns(n, 2, 0) for n in names} == {True, False}
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        for n in names:
+            await env.expect_nodeclaim_ready(n)
+        # the partition was load-bearing: every pool exists exactly once
+        # (no double-create from overlapping reconciles)
+        pools = [p.name for p in await env.kaito_pools()]
+        assert sorted(pools) == sorted(names)
