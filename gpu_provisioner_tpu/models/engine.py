@@ -423,6 +423,23 @@ class ServeEngine:
     def pending(self) -> int:
         return len(self._queue) + sum(s is not None for s in self._slot)
 
+    def stats(self) -> dict:
+        """Serving observability counters (the engine analog of the
+        control plane's Prometheus surface): slot occupancy, queue depth,
+        totals, prefix-cache effectiveness."""
+        emitted = sum(len(v) for v in self.finished.values()) + sum(
+            len(s.emitted) for s in self._slot if s is not None)
+        return {
+            "slots": self.slots,
+            "slots_active": sum(s is not None for s in self._slot),
+            "queue_depth": len(self._queue),
+            "requests_submitted": self._next_id,
+            "requests_finished": len(self.finished),
+            "tokens_emitted": emitted,
+            "prefix_cache_entries": len(self._prefix_lru),
+            "prefix_cache_misses": self.prefix_misses,
+        }
+
     def step(self) -> dict[int, list[int]]:
         """Admit what fits, then advance every active slot one token.
         Returns {req_id: [tokens]} for EVERY token emitted this step — a

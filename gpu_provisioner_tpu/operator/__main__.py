@@ -61,8 +61,7 @@ async def run_simulate(opts) -> int:
                 # a sharded simulate run only reconciles its own claims —
                 # waiting on foreign ones would time out by design
                 owned = [n for n in names
-                         if opts.shards == 1
-                         or shard_owns(n, opts.shards, opts.shard_index)]
+                         if shard_owns(n, opts.shards, opts.shard_index)]
                 for name in owned:
                     nc = await env.wait_ready(name, timeout=120)
                     log.info("nodeclaim ready", extra={

@@ -270,14 +270,18 @@ class Environment:
                 if self.nodepools is not None:
                     await self.nodepools.aclose()
             return
-        for proc, _pump in [(self.proc, self._log_task)] + self._extra:
-            if proc and proc.returncode is None:
-                proc.terminate()
-                try:
-                    await asyncio.wait_for(proc.wait(), 10)
-                except asyncio.TimeoutError:
-                    proc.kill()
-                    await proc.wait()
+        procs = [p for p, _ in [(self.proc, self._log_task)] + self._extra
+                 if p and p.returncode is None]
+        for proc in procs:          # signal everyone first, then reap
+            proc.terminate()        # concurrently (10s total, not per proc)
+
+        async def _reap(proc):
+            try:
+                await asyncio.wait_for(proc.wait(), 10)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+        await asyncio.gather(*(_reap(p) for p in procs))
         for _proc, pump in self._extra:
             pump.cancel()
         if self._log_task:

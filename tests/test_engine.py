@@ -110,6 +110,22 @@ def test_engine_flash_kernels_and_moe():
     assert mout[rid] == [int(t) for t in want[0]]
 
 
+def test_engine_sliding_window_serving():
+    """SWA serving through the engine: the window mask + per-slot pads
+    compose in the decode path — streams equal generate() with the same
+    window config."""
+    cfg_w = dataclasses.replace(CFG, sliding_window=12)
+    eng = ServeEngine(PARAMS, cfg_w, slots=2, max_len=64,
+                      prefill_buckets=(16,))
+    p = _prompt(35, 9)
+    rid = eng.submit(p, 8)
+    out = eng.run()
+    padded = jnp.asarray([[0] * 7 + p], jnp.int32)   # bucket 16, 7 pads
+    want = generate(PARAMS, padded, cfg_w, max_new_tokens=8, max_len=64,
+                    pad_id=0)
+    assert out[rid] == [int(t) for t in want[0]]
+
+
 def test_engine_int8_cache():
     """The memory-constrained serving configuration: int8 KV cache rides
     the same insert/step machinery (scales inserted alongside values)."""
@@ -303,6 +319,24 @@ def test_engine_prefix_validation():
                        slots=1, max_len=64, prefill_buckets=(16,))
     with pytest.raises(ValueError, match="dense family"):
         meng.submit(_prompt(82, 8), 4, prefix=_prompt(83, 8))
+
+
+def test_engine_stats_counters():
+    eng = ServeEngine(PARAMS, CFG, slots=2, max_len=64,
+                      prefill_buckets=(16,))
+    assert eng.stats()["slots_active"] == 0
+    r1 = eng.submit(_prompt(90, 8), 4)
+    eng.submit(_prompt(91, 8), 4)
+    eng.submit(_prompt(92, 8), 4)        # queues behind 2 slots
+    eng.step()
+    s = eng.stats()
+    assert s["slots_active"] == 2 and s["queue_depth"] == 1
+    assert s["requests_submitted"] == 3
+    eng.run()
+    s = eng.stats()
+    assert s["requests_finished"] == 3 and s["slots_active"] == 0
+    assert s["tokens_emitted"] == 12
+    assert len(eng.finished[r1]) == 4
 
 
 def test_engine_validation():
