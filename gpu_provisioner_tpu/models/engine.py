@@ -74,6 +74,7 @@ class Request:
 class _Slot:
     req: Request
     emitted: list[int] = field(default_factory=list)
+    lps: list[float] = field(default_factory=list)
 
 
 class ServeEngine:
@@ -108,7 +109,15 @@ class ServeEngine:
     family only: right-pad garbage rows would compete for MoE routing
     capacity, so MoE prefixes raise. Cost: one full cache row
     ([L, 1, Hkv, max_len, Dh]) of HBM per cached prefix
-    (``prefix_cache_size`` bounds it)."""
+    (``prefix_cache_size`` bounds it).
+
+    ``return_logprobs``: also record each emitted token's log-probability
+    under the sampling distribution (generate()'s convention — greedy:
+    the unfiltered distribution; sampled: the filtered one actually
+    drawn from; speculative slots score under the target's verify
+    distribution, speculative_generate's convention). Logprobs align 1:1
+    with the emitted streams (the engine truncates AT eos, so there are
+    no forced-eos fill positions) and land in ``finished_logprobs``."""
 
     def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
                  max_len: int = 2048,
@@ -116,7 +125,8 @@ class ServeEngine:
                  temperature: float = 0.0, top_k: int = None,
                  top_p: int = None, key=None,
                  draft_params=None, draft_cfg: LlamaConfig = None,
-                 spec_k: int = 4, prefix_cache_size: int = 8):
+                 spec_k: int = 4, prefix_cache_size: int = 8,
+                 return_logprobs: bool = False):
         _resolve_attn(cfg.attn_impl, cfg.sliding_window,
                       cfg.attn_sinks)        # loud validation, as everywhere
         validate_sampling_args(temperature, top_k, top_p, key)
@@ -159,12 +169,19 @@ class ServeEngine:
                 length=jnp.where(active, cache.length, safe))
             lg = logits[:, 0]
             if temperature > 0:
-                nxt = jax.random.categorical(
-                    key, filter_logits(lg, temperature, top_k, top_p),
-                    axis=-1).astype(jnp.int32)
+                dist = filter_logits(lg, temperature, top_k, top_p)
+                nxt = jax.random.categorical(key, dist,
+                                             axis=-1).astype(jnp.int32)
             else:
+                dist = lg     # greedy reports the unfiltered distribution
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return nxt, cache
+            if return_logprobs:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(dist, axis=-1), nxt[:, None],
+                    axis=-1)[:, 0]
+            else:               # static flag: don't pay the full-vocab
+                lp = jnp.zeros(nxt.shape)          # softmax when off
+            return nxt, lp, cache
 
         self._step = jax.jit(_step, donate_argnums=(2,))
 
@@ -225,7 +242,7 @@ class ServeEngine:
                                     dropless_step=True)[1]
                 step_d = family_fns(draft_cfg, pad_lens=pads)[1]
                 (emit_vec, _keep, emit_n, new_last, cache_t, cache_d,
-                 _logits) = spec_round(
+                 verify_logits) = spec_round(
                     step_t, step_d, params, dparams, last, done, cache_t,
                     cache_d, key, spec_k=spec_k,
                     draft_vocab=draft_cfg.vocab_size, max_len=max_len,
@@ -234,10 +251,17 @@ class ServeEngine:
                 # pack the two host-bound outputs into ONE transfer and
                 # drop the [slots, k+1, V] verify logits on device — jit
                 # outputs cannot be DCE'd, so returning them would write
-                # MBs of never-read HBM per step
+                # MBs of never-read HBM per step. Logprobs, when on, ride
+                # as a tiny [slots, k+1] f32 (not the V-wide logits).
                 packed = jnp.concatenate([emit_vec, emit_n[:, None]],
                                          axis=1)          # [slots, k+2]
-                return packed, new_last, cache_t, cache_d
+                if return_logprobs:
+                    wlp = jnp.take_along_axis(
+                        jax.nn.log_softmax(verify_logits, axis=-1),
+                        emit_vec[..., None], axis=-1)[..., 0]
+                else:
+                    wlp = jnp.zeros(emit_vec.shape)
+                return packed, wlp, new_last, cache_t, cache_d
 
             self._spec_step = jax.jit(_spec_step, donate_argnums=(4, 5))
 
@@ -259,6 +283,9 @@ class ServeEngine:
         self.prefix_cache_size = prefix_cache_size
         self._prefix_lru: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_misses = 0               # observability + tests
+        self.prefix_hits = 0
+        self.return_logprobs = return_logprobs
+        self.finished_logprobs: dict[int, list[float]] = {}
 
     # --- request lifecycle --------------------------------------------------
 
@@ -338,12 +365,16 @@ class ServeEngine:
                         jnp.asarray([pad], jnp.int32))
             if self.temperature > 0:
                 self._key, k0 = jax.random.split(self._key)
-                tok0 = jax.random.categorical(
-                    k0, filter_logits(lg, self.temperature, self.top_k,
-                                      self.top_p), axis=-1)
+                dist = filter_logits(lg, self.temperature, self.top_k,
+                                     self.top_p)
+                tok0 = jax.random.categorical(k0, dist, axis=-1)
             else:
+                dist = lg
                 tok0 = jnp.argmax(lg, axis=-1)
             tok0 = int(tok0[0])
+            lp0 = 0.0
+            if self.return_logprobs:
+                lp0 = float(jax.nn.log_softmax(dist, axis=-1)[0, tok0])
             self.cache = self._insert(self.cache, cache1,
                                       jnp.asarray(s, jnp.int32),
                                       jnp.asarray(length, jnp.int32))
@@ -353,7 +384,7 @@ class ServeEngine:
                     jnp.asarray(length, jnp.int32))
             self._pads = self._pads.at[s].set(pad)
             self._last = self._last.at[s].set(tok0)
-            self._slot[s] = _Slot(req, [tok0])
+            self._slot[s] = _Slot(req, [tok0], [lp0])
             emitted.setdefault(req.req_id, []).append(tok0)
             self._maybe_finish(s)
 
@@ -365,6 +396,7 @@ class ServeEngine:
         set (an exact-length prefill would compile per distinct length)."""
         hit = self._prefix_lru.get(prefix)
         if hit is not None:
+            self.prefix_hits += 1
             self._prefix_lru.move_to_end(prefix)
             return hit
         self.prefix_misses += 1
@@ -410,6 +442,8 @@ class ServeEngine:
             req.eos_id is not None and slot.emitted[-1] == req.eos_id)
         if done:
             self.finished[req.req_id] = slot.emitted
+            if self.return_logprobs:
+                self.finished_logprobs[req.req_id] = slot.lps
             self._slot[s] = None
             self.cache = self.cache._replace(
                 length=self.cache.length.at[s].set(0))
@@ -437,6 +471,7 @@ class ServeEngine:
             "requests_finished": len(self.finished),
             "tokens_emitted": emitted,
             "prefix_cache_entries": len(self._prefix_lru),
+            "prefix_cache_hits": self.prefix_hits,
             "prefix_cache_misses": self.prefix_misses,
         }
 
@@ -459,14 +494,18 @@ class ServeEngine:
             kt = jax.random.key(0)
         if self.draft_cfg is not None:
             return self._spec_advance(out, active_slots, active, kt)
-        nxt, self.cache = self._step(self.params, self._last[:, None],
-                                     self.cache, self._pads, active, kt)
+        nxt, lp, self.cache = self._step(self.params, self._last[:, None],
+                                         self.cache, self._pads, active,
+                                         kt)
         self._last = nxt
         toks = np.asarray(nxt)               # the one host sync per step
+        lps = np.asarray(lp) if self.return_logprobs else None
         for s in active_slots:
             t = int(toks[s])
             slot = self._slot[s]
             slot.emitted.append(t)
+            if lps is not None:
+                slot.lps.append(float(lps[s]))
             out.setdefault(slot.req.req_id, []).append(t)
             self._maybe_finish(s)
         return out
@@ -476,12 +515,14 @@ class ServeEngine:
         per slot per step. Quota/eos truncation happens host-side — a
         truncated slot always FINISHES, so its device state (which ran
         ahead by the truncated tokens) is discarded with the slot."""
-        packed, new_last, self.cache, self.draft_cache = self._spec_step(
+        (packed, wlp, new_last, self.cache,
+         self.draft_cache) = self._spec_step(
             self.params, self.draft_params, self._last, ~active,
             self.cache, self.draft_cache, self._pads, kt)
         self._last = new_last
         host = np.asarray(packed)            # the one host sync per step
         ev, en = host[:, :-1], host[:, -1]
+        lps = np.asarray(wlp) if self.return_logprobs else None
         for s in active_slots:
             slot = self._slot[s]
             req = slot.req
@@ -490,6 +531,9 @@ class ServeEngine:
             if req.eos_id is not None and req.eos_id in new:
                 new = new[:new.index(req.eos_id) + 1]
             slot.emitted.extend(new)
+            if lps is not None:              # logprobs align 1:1 with the
+                slot.lps.extend(             # truncated token window
+                    float(x) for x in lps[s][:len(new)])
             if new:
                 out.setdefault(req.req_id, []).extend(new)
             self._maybe_finish(s)

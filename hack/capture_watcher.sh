@@ -2,11 +2,19 @@
 # Round-5 capture watcher (committed for transparency — BENCH_NOTES_r05.md
 # describes its role): probes the axon tunnel every ~3 min with a process
 # that is NEVER timeout-killed (killing a backend-attached process is the
-# documented remote-wedge trigger); on the first successful probe it runs,
-# from a snapshot of HEAD: hack/tpu_onchip_checks.py then the full
-# bench.py, committing-ready logs into the repo. Ran in the background for
-# the whole round from /tmp (tunnel_probe.py = "import jax;
-# print(jax.devices())" with flush).
+# documented remote-wedge trigger); on a successful probe it runs, from a
+# snapshot of HEAD: hack/tpu_onchip_checks.py then the full bench.py,
+# writing logs into the repo. A failed capture (nonzero rc) keeps the
+# partial logs and loops back to probing — /tmp/capture_done marks only a
+# FULLY-successful capture. Self-contained: the probe is written below.
+cat > /tmp/tunnel_probe.py <<'PY'
+import time
+t0 = time.time()
+print(f"probe start {t0}", flush=True)
+import jax
+devs = jax.devices()
+print(f"probe ok {time.time()-t0:.1f}s devices={devs}", flush=True)
+PY
 while true; do
   python -u /tmp/tunnel_probe.py > /tmp/tunnel_probe_last.log 2>&1
   if grep -q "probe ok" /tmp/tunnel_probe_last.log; then
@@ -16,15 +24,19 @@ while true; do
     cd /tmp/capture_tree
     git -C /root/repo rev-parse HEAD > /root/repo/hack/capture_head_r05.txt
     python -u hack/tpu_onchip_checks.py > /root/repo/hack/tpu_onchip_checks_r05.log 2>&1
-    rc=$?   # capture BEFORE the $(date) substitution resets $?
-    echo "$(date -u +%H:%M:%S) onchip checks rc=$rc done" >> /tmp/watcher.log
+    rc1=$?   # capture BEFORE the $(date) substitution resets $?
+    echo "$(date -u +%H:%M:%S) onchip checks rc=$rc1 done" >> /tmp/watcher.log
     python -u bench.py > /root/repo/bench_live_r05.log 2>&1
-    rc=$?
-    echo "$(date -u +%H:%M:%S) bench rc=$rc done" >> /tmp/watcher.log
+    rc2=$?
+    echo "$(date -u +%H:%M:%S) bench rc=$rc2 done" >> /tmp/watcher.log
     cp bench_tpu_sections.jsonl.* /root/repo/hack/ 2>/dev/null
-    touch /tmp/capture_done
-    exit 0
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
+      touch /tmp/capture_done
+      exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) capture incomplete — partial logs kept, re-probing" >> /tmp/watcher.log
+  else
+    echo "$(date -u +%H:%M:%S) tunnel down ($(tail -1 /tmp/tunnel_probe_last.log | head -c 80))" >> /tmp/watcher.log
   fi
-  echo "$(date -u +%H:%M:%S) tunnel down ($(tail -1 /tmp/tunnel_probe_last.log | head -c 80))" >> /tmp/watcher.log
   sleep 180
 done

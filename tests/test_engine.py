@@ -249,6 +249,8 @@ def test_engine_prefix_caching_exact_and_lru():
         reqs[eng.submit(p, 6, prefix=prefix)] = p
     out = eng.run()
     assert eng.prefix_misses == 1            # prefilled once, reused 3×
+    assert eng.prefix_hits == 3
+    assert eng.stats()["prefix_cache_hits"] == 3
     for rid, p in reqs.items():
         assert out[rid] == _solo(prefix + p, 6), f"req {rid}"
     # a second prefix shares the cache; a third evicts the LRU entry
@@ -319,6 +321,39 @@ def test_engine_prefix_validation():
                        slots=1, max_len=64, prefill_buckets=(16,))
     with pytest.raises(ValueError, match="dense family"):
         meng.submit(_prompt(82, 8), 4, prefix=_prompt(83, 8))
+
+
+def test_engine_logprobs_match_generate():
+    """return_logprobs: per-token logprobs equal generate()'s for the
+    same stream — plain AND speculative engines (the speculative path
+    scores under the verify distribution, speculative_generate's
+    convention, which provably equals plain greedy's)."""
+    import numpy as np
+
+    p = _prompt(95, 9)
+    want_t, want_lp = generate(PARAMS, jnp.asarray([p], jnp.int32), CFG,
+                               max_new_tokens=6, max_len=256,
+                               return_logprobs=True)
+    eng = ServeEngine(PARAMS, CFG, slots=2, max_len=64,
+                      prefill_buckets=(16,), return_logprobs=True)
+    rid = eng.submit(p, 6)
+    out = eng.run()
+    assert out[rid] == [int(t) for t in want_t[0]]
+    np.testing.assert_allclose(eng.finished_logprobs[rid],
+                               np.asarray(want_lp[0]), atol=1e-5)
+
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(jax.random.key(3), draft_cfg)
+    seng = ServeEngine(PARAMS, CFG, slots=2, max_len=64,
+                       prefill_buckets=(16,), draft_params=draft,
+                       draft_cfg=draft_cfg, spec_k=3,
+                       return_logprobs=True)
+    rid = seng.submit(p, 6)
+    sout = seng.run()
+    assert sout[rid] == [int(t) for t in want_t[0]]
+    assert len(seng.finished_logprobs[rid]) == 6
+    np.testing.assert_allclose(seng.finished_logprobs[rid],
+                               np.asarray(want_lp[0]), atol=1e-5)
 
 
 def test_engine_stats_counters():
