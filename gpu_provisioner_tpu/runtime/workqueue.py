@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import heapq
 import time
+from collections import deque
 from typing import Any, Hashable, Optional
 
 
@@ -19,7 +20,9 @@ class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
         self.base_delay = base_delay
         self.max_delay = max_delay
-        self._queue: list[Hashable] = []
+        # deque: get() pops from the FRONT — list.pop(0) is O(depth)
+        # and a fleet wave holds thousands of ready items
+        self._queue: deque[Hashable] = deque()
         self._dirty: set[Hashable] = set()
         self._processing: set[Hashable] = set()
         self._failures: dict[Hashable, int] = {}
@@ -115,7 +118,7 @@ class RateLimitingQueue:
             while True:
                 self._drain_delayed_locked()  # cheap catch-up; timer notifies
                 if self._queue:
-                    item = self._queue.pop(0)
+                    item = self._queue.popleft()
                     self._dirty.discard(item)
                     self._processing.add(item)
                     return item
