@@ -124,6 +124,22 @@ def test_speculative_batched_moe_target():
     assert (got == want).all()
 
 
+def test_speculative_batched_int8_target():
+    """Batched speculation against an int8-cache target: the per-row
+    verify writes land VALUES AND SCALES at per-row offsets (the scale
+    buffers ride the same vmapped scatter) — stream equals plain int8
+    decode row-for-row."""
+    import dataclasses
+
+    cfg8 = dataclasses.replace(CFG_T, kv_cache_dtype="int8")
+    params, draft = _models(seed=10)
+    prompt = jax.random.randint(jax.random.key(30), (3, 16), 0, 128)
+    want = generate(params, prompt, cfg8, max_new_tokens=12, max_len=256)
+    got, _ = speculative_generate(params, draft, prompt, cfg8, CFG_D,
+                                  max_new_tokens=12, spec_k=3)
+    assert (got == want).all()
+
+
 def test_speculative_batched_sampled_in_vocab_reproducible():
     """Sampled batched speculation: deterministic under a fixed key, all
     tokens in-vocab, per-row token counts correct."""
