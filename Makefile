@@ -27,6 +27,12 @@ unit-test: ## Unit tests (reference Makefile:171-175)
 e2etests: ## e2e suite: real operator subprocess vs HTTP fakes (Makefile:177-187)
 	$(PY) -m pytest tests/e2e -q
 
+CHAOS_SEED ?= 7
+
+.PHONY: chaos
+chaos: ## Chaos soak suite under a fixed seed (see docs/FAILURE_MODES.md)
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py -q -m chaos
+
 .PHONY: e2etests-real
 e2etests-real: ## Same specs against a live cluster (suite_test.go:34-45 mode).
 	## Prereqs: operator deployed (make helm-install), KUBECONFIG pointing at

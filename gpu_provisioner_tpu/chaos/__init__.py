@@ -1,0 +1,12 @@
+"""Chaos-injection subsystem: seeded fault policies for the simulated cloud
+and kube client, plus the named profiles the soak suite runs under."""
+
+from .client import ChaosClient, ChaosClientError, transient_kube
+from .policy import (
+    ChaosPolicy, FaultRule, PROFILES, profile, stockout, transient,
+)
+
+__all__ = [
+    "ChaosClient", "ChaosClientError", "ChaosPolicy", "FaultRule",
+    "PROFILES", "profile", "stockout", "transient", "transient_kube",
+]

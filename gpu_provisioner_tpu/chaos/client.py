@@ -1,0 +1,65 @@
+"""Chaos wrapper over the kube ``Client`` seam.
+
+Injects the policy's ``kube.*`` rules in front of any Client implementation
+(latency on reads, transient ``ClientError`` on writes, …) so controllers can
+be soaked against a flaky apiserver, not just a flaky cloud. Watches pass
+through untouched: the in-memory watch path has no real failure mode to
+simulate and dropping events would test the store, not the controllers.
+"""
+
+from __future__ import annotations
+
+from ..runtime.client import Client, ClientError
+
+
+class ChaosClientError(ClientError):
+    """Injected apiserver failure (reconcilers treat it like any transient
+    client error: the workqueue's backoff owns the retry)."""
+
+
+def transient_kube(message: str = "chaos: apiserver unavailable"):
+    """Error factory for ``FaultRule(error=...)`` on ``kube.*`` sites."""
+    return lambda: ChaosClientError(message)
+
+
+class ChaosClient:
+    """Delegating Client that runs ``policy.before_call("kube", <method>)``
+    ahead of every API method."""
+
+    def __init__(self, inner: Client, policy):
+        self.inner = inner
+        self.policy = policy
+        # controllers reach for .store (index registration) on the raw client
+        self.store = getattr(inner, "store", None)
+
+    async def get(self, cls, name, namespace=""):
+        await self.policy.before_call("kube", "get")
+        return await self.inner.get(cls, name, namespace)
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        await self.policy.before_call("kube", "list")
+        return await self.inner.list(cls, labels=labels, namespace=namespace,
+                                     index=index)
+
+    async def create(self, obj):
+        await self.policy.before_call("kube", "create")
+        return await self.inner.create(obj)
+
+    async def update(self, obj):
+        await self.policy.before_call("kube", "update")
+        return await self.inner.update(obj)
+
+    async def update_status(self, obj):
+        await self.policy.before_call("kube", "update_status")
+        return await self.inner.update_status(obj)
+
+    async def delete(self, cls, name, namespace=""):
+        await self.policy.before_call("kube", "delete")
+        return await self.inner.delete(cls, name, namespace)
+
+    async def evict(self, name, namespace="", uid=""):
+        await self.policy.before_call("kube", "evict")
+        return await self.inner.evict(name, namespace, uid)
+
+    def watch(self, cls):
+        return self.inner.watch(cls)

@@ -1,0 +1,228 @@
+"""Deterministic, seeded chaos policies for the fake cloud + kube client.
+
+The reference repo survives real clouds because every layer assumes the cloud
+misbehaves; its fakes can only script one fault at a time
+(``_FaultInjector.fail(method, times=1)``). This module generalizes that into
+a *policy*: probabilistic errors, latency/hang injection, error schedules
+(bursts), and partial-failure modes (pool created but nodes never join,
+queued resource stuck mid-ladder, operation ``result()`` raising after
+``done()``) — so any envtest scenario can run under a named chaos profile and
+still be reproducible.
+
+Determinism without a shared RNG stream: every decision is a pure hash of
+``(seed, decision key)``. Concurrent reconciles interleave differently from
+run to run, which would desynchronize a sequential PRNG; keyed draws make
+each decision independent of scheduling order — ``should("no_join", pool)``
+answers the same for a given seed no matter when it is asked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from collections import defaultdict
+from dataclasses import dataclass
+from fnmatch import fnmatch
+from typing import Callable, Optional
+
+from ..providers.gcp import APIError
+
+
+@dataclass
+class FaultRule:
+    """One injection rule, matched against ``scope.method`` call sites
+    (e.g. ``nodepools.begin_create``, ``queuedresources.*``, ``kube.list``).
+
+    ``rate`` is the per-call probability that ``error()`` is raised.
+    ``after``/``until`` window the rule to a call-count range of the matched
+    site, which is how bursts/outage schedules are expressed (calls 0..until
+    fail, then recovery). ``latency`` sleeps before the error check on every
+    matched call; ``hang``/``hang_rate`` sleep long enough to trip a
+    reconcile deadline (the wedged-API simulation).
+    """
+
+    match: str
+    rate: float = 0.0
+    error: Optional[Callable[[], Exception]] = None
+    latency: float = 0.0
+    hang: float = 0.0
+    hang_rate: float = 0.0
+    after: int = 0
+    until: Optional[int] = None
+
+
+def transient(code: int = 503, message: str = "chaos: transient") -> Callable[[], Exception]:
+    return lambda: APIError(message, code=code)
+
+
+def stockout(message: str = "chaos: out of TPU capacity") -> Callable[[], Exception]:
+    return lambda: APIError(message, code=429)
+
+
+class ChaosPolicy:
+    """A seeded bundle of fault rules + partial-failure mode rates.
+
+    Partial modes (consumed by ``FakeCloud``):
+
+    - ``no_join``    node pool creates fine, kubelets never join (keyed per
+                     pool name: a doomed pool stays doomed across retries —
+                     that is the scenario's point).
+    - ``qr_stuck``   queued resource never advances past CREATING (keyed per
+                     resource name).
+    - ``op_error``   LRO ``done()`` returns True but ``result()`` raises and
+                     the pool lands in ERROR (keyed per pool name *and*
+                     attempt, so retries can eventually succeed).
+    """
+
+    def __init__(self, seed: int = 0, rules: Optional[list[FaultRule]] = None,
+                 partial: Optional[dict[str, float]] = None):
+        self.seed = seed
+        self.rules = list(rules or [])
+        self.partial = dict(partial or {})
+        self._site_calls: dict[str, int] = defaultdict(int)
+        self._key_calls: dict[tuple, int] = defaultdict(int)
+        # observability for soak assertions: what actually fired
+        self.injected: dict[str, int] = defaultdict(int)
+        self.calls: dict[str, int] = defaultdict(int)
+
+    # ------------------------------------------------------------- draws
+    def _draw(self, *key) -> float:
+        """Pure hash draw in [0, 1): independent of call ordering."""
+        h = hashlib.sha256(repr((self.seed,) + key).encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2 ** 64
+
+    # ---------------------------------------------------------- call path
+    async def before_call(self, scope: str, method: str) -> None:
+        """Instrumentation hook fakes call before executing an API method.
+        May sleep (latency/hang) and/or raise the rule's error."""
+        site = f"{scope}.{method}"
+        n = self._site_calls[site]
+        self._site_calls[site] = n + 1
+        self.calls[site] += 1
+        for i, rule in enumerate(self.rules):
+            if not fnmatch(site, rule.match):
+                continue
+            if n < rule.after or (rule.until is not None and n >= rule.until):
+                continue
+            if rule.latency > 0:
+                await asyncio.sleep(rule.latency)
+            if rule.hang > 0 and (rule.hang_rate >= 1.0 or
+                                  self._draw("hang", i, site, n) < rule.hang_rate):
+                self.injected[f"hang:{site}"] += 1
+                await asyncio.sleep(rule.hang)
+            if rule.error is not None and (
+                    rule.rate >= 1.0 or self._draw("err", i, site, n) < rule.rate):
+                self.injected[f"error:{site}"] += 1
+                raise rule.error()
+
+    # ------------------------------------------------------ partial modes
+    def should(self, mode: str, key: str, per_attempt: bool = False) -> bool:
+        """Deterministic partial-failure decision for ``key`` (a pool or
+        queued-resource name). ``per_attempt`` folds a per-key call counter
+        into the draw so repeated attempts re-roll (op_error); without it the
+        decision is stable for the key's lifetime (no_join, qr_stuck)."""
+        rate = self.partial.get(mode, 0.0)
+        if rate <= 0:
+            return False
+        draw_key: tuple = (mode, key)
+        if per_attempt:
+            n = self._key_calls[(mode, key)]
+            self._key_calls[(mode, key)] = n + 1
+            draw_key = (mode, key, n)
+        hit = rate >= 1.0 or self._draw(*draw_key) < rate
+        if hit:
+            self.injected[f"{mode}:{key}"] += 1
+        return hit
+
+    def injected_total(self, prefix: str = "") -> int:
+        return sum(v for k, v in self.injected.items() if k.startswith(prefix))
+
+
+# ------------------------------------------------------------------ profiles
+
+PROFILES: dict[str, Callable[[int], ChaosPolicy]] = {}
+
+
+def profile(name: str, seed: int = 0) -> ChaosPolicy:
+    """Build a named chaos profile. Profiles are the vocabulary the soak
+    suite (tests/test_chaos.py), ``make chaos``, and docs/FAILURE_MODES.md
+    share."""
+    try:
+        factory = PROFILES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown chaos profile {name!r}; known: {sorted(PROFILES)}")
+    return factory(seed)
+
+
+def _register(name: str):
+    def deco(fn):
+        PROFILES[name] = fn
+        return fn
+    return deco
+
+
+@_register("flaky-cloud")
+def _flaky_cloud(seed: int) -> ChaosPolicy:
+    """20% transient 5xx on every cloud API call — the everyday GKE/TPU
+    weather. Everything must still converge via retry + backoff."""
+    return ChaosPolicy(seed, rules=[
+        FaultRule(match="nodepools.*", rate=0.2, error=transient(503)),
+        FaultRule(match="queuedresources.*", rate=0.2, error=transient(500)),
+    ])
+
+
+@_register("stockout")
+def _stockout(seed: int) -> ChaosPolicy:
+    """RESOURCE_EXHAUSTED bursts: the first creates hit a stockout (terminal
+    for those claims — deleted, KAITO would re-shape), later creates go
+    through. Mixed terminal/success convergence."""
+    return ChaosPolicy(seed, rules=[
+        FaultRule(match="nodepools.begin_create", rate=1.0, until=2,
+                  error=stockout()),
+        FaultRule(match="nodepools.*", rate=0.1, error=transient(503)),
+    ])
+
+
+@_register("partial-provision")
+def _partial_provision(seed: int) -> ChaosPolicy:
+    """Pools create and report RUNNING, but for ~half of them the kubelets
+    never join (half-created capacity — the dominant leak shape). Liveness
+    must reap the claims, GC must reap the pools."""
+    return ChaosPolicy(seed, partial={"no_join": 0.5})
+
+
+@_register("stuck-queue")
+def _stuck_queue(seed: int) -> ChaosPolicy:
+    """Queued resources wedge mid-ladder (stuck CREATING forever) — the
+    Cloud TPU stockout-queue pathology. Claims on the queued path must hit
+    the launch liveness deadline, not spin."""
+    return ChaosPolicy(seed, partial={"qr_stuck": 1.0})
+
+
+@_register("op-error")
+def _op_error(seed: int) -> ChaosPolicy:
+    """LROs complete (``done()`` True) but ``result()`` raises and the pool
+    lands in ERROR ~half the time per attempt — create retries must replace
+    the carcass, never duplicate it."""
+    return ChaosPolicy(seed, partial={"op_error": 0.5})
+
+
+@_register("outage")
+def _outage(seed: int) -> ChaosPolicy:
+    """Sustained full outage of the node-pool API: every call fails 503.
+    Nothing converges — the assertion is about *cost*: backoff/breaker keep
+    the call rate O(probe interval), not O(retry storm)."""
+    return ChaosPolicy(seed, rules=[
+        FaultRule(match="nodepools.*", rate=1.0, error=transient(503)),
+    ])
+
+
+@_register("slow-cloud")
+def _slow_cloud(seed: int) -> ChaosPolicy:
+    """Every cloud call is slow and some hang long enough to trip reconcile
+    deadlines — exercises per-reconcile cancellation."""
+    return ChaosPolicy(seed, rules=[
+        FaultRule(match="nodepools.*", latency=0.02, hang=5.0, hang_rate=0.1),
+        FaultRule(match="queuedresources.*", latency=0.02),
+    ])

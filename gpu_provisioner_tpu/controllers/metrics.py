@@ -4,10 +4,14 @@ Name-compatible with the reference's nodeclaim metrics
 (vendor/sigs.k8s.io/karpenter/pkg/metrics/metrics.go:33-60 and
 lifecycle/controller.go:249-266), plus a provision-duration histogram — the
 headline NodeClaim→Ready latency from BASELINE.json that the reference never
-measured.
+measured — and the robustness surface: reconcile deadline/retry-exhaustion
+counters, workqueue depth/backlog gauges, and circuit-breaker state
+(refreshed from live objects by ``update_runtime_gauges`` at scrape time).
 """
 
-from prometheus_client import REGISTRY, Counter, Histogram
+from prometheus_client import REGISTRY, Counter, Gauge, Histogram
+
+from ..transport import BREAKER_HALF_OPEN, BREAKER_OPEN, BREAKERS
 
 
 def _get_or_create(cls, name, doc, labelnames, **kw):
@@ -39,3 +43,75 @@ PROVISION_DURATION = _get_or_create(
 CHIPS_PROVISIONED = _get_or_create(
     Counter, "tpu_chips_provisioned_total",
     "Total TPU chips brought to Ready.", ["generation"])
+
+# ---------------------------------------------------------------- robustness
+
+RECONCILE_TIMEOUTS = _get_or_create(
+    Counter, "tpu_provisioner_reconcile_timeouts_total",
+    "Reconciles cancelled at the per-reconcile deadline.", ["controller"])
+
+RECONCILE_RETRIES_EXHAUSTED = _get_or_create(
+    Counter, "tpu_provisioner_reconcile_retries_exhausted_total",
+    "Items that hit the per-item retry bound and degraded to slow retry.",
+    ["controller"])
+
+WORKQUEUE_DEPTH = _get_or_create(
+    Gauge, "tpu_provisioner_workqueue_depth",
+    "Items ready for a worker right now.", ["controller"])
+
+WORKQUEUE_DELAYED = _get_or_create(
+    Gauge, "tpu_provisioner_workqueue_delayed",
+    "Items parked in rate-limit backoff.", ["controller"])
+
+WORKQUEUE_RETRYING = _get_or_create(
+    Gauge, "tpu_provisioner_workqueue_retrying",
+    "Items with a live failure count (requeued since their last forget).",
+    ["controller"])
+
+# Cumulative values sampled into gauges at scrape time (the counters live on
+# runtime objects prometheus can't own) — named WITHOUT the _total suffix,
+# which is reserved for true Counter semantics.
+WORKQUEUE_REQUEUES = _get_or_create(
+    Gauge, "tpu_provisioner_workqueue_requeues",
+    "Cumulative rate-limited requeues (sampled from the queue counter).",
+    ["controller"])
+
+# 0 = closed, 1 = half-open, 2 = open (alert on >= 1).
+BREAKER_STATE = _get_or_create(
+    Gauge, "tpu_provisioner_circuit_breaker_state",
+    "Circuit breaker state: 0 closed, 1 half-open, 2 open.", ["name"])
+
+BREAKER_REJECTED = _get_or_create(
+    Gauge, "tpu_provisioner_circuit_breaker_rejected",
+    "Cumulative calls rejected locally while the breaker was open "
+    "(sampled).", ["name"])
+
+_BREAKER_STATE_VALUE = {BREAKER_OPEN: 2.0, BREAKER_HALF_OPEN: 1.0}
+_exported_breakers: set[str] = set()
+
+
+def update_runtime_gauges(manager) -> None:
+    """Refresh workqueue + breaker gauges from live objects. Called by the
+    /metrics handler at scrape time (and by soak tests directly) — gauges
+    sample state that lives in the runtime layer, which must not import
+    prometheus."""
+    for c in getattr(manager, "controllers", []):
+        q = c.queue
+        WORKQUEUE_DEPTH.labels(c.name).set(q.depth())
+        WORKQUEUE_DELAYED.labels(c.name).set(q.delayed())
+        WORKQUEUE_RETRYING.labels(c.name).set(q.retrying())
+        WORKQUEUE_REQUEUES.labels(c.name).set(q.requeues_total)
+    # Drop series for breakers whose client closed — a stale "open" reading
+    # would keep an alert firing for an endpoint nothing gates on anymore.
+    for name in _exported_breakers - set(BREAKERS):
+        try:
+            BREAKER_STATE.remove(name)
+            BREAKER_REJECTED.remove(name)
+        except KeyError:
+            pass
+    _exported_breakers.intersection_update(BREAKERS)
+    for name, breaker in BREAKERS.items():
+        BREAKER_STATE.labels(name).set(
+            _BREAKER_STATE_VALUE.get(breaker.state, 0.0))
+        BREAKER_REJECTED.labels(name).set(breaker.rejected_total)
+        _exported_breakers.add(name)

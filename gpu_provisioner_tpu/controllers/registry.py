@@ -21,6 +21,7 @@ from ..runtime.events import Recorder
 from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
+from .metrics import RECONCILE_RETRIES_EXHAUSTED, RECONCILE_TIMEOUTS
 from .slicegroup import SliceGroupController, group_requests
 from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
 from .utils import shard_owns
@@ -49,6 +50,15 @@ def build_controllers(client: Client, cloudprovider,
                       max_concurrent_reconciles: int = 64,
                       cluster: str = "kaito",
                       shards: int = 1, shard_index: int = 0,
+                      reconcile_timeout: Optional[float] = None,
+                      # By 30 consecutive failures the jittered ladder has
+                      # reached the queue's max-delay cap anyway, so the
+                      # bound changes observability (warning event + metric
+                      # + counter reset), not cadence — and it can never
+                      # out-race a liveness budget the way a tighter bound
+                      # could (the ladder's cumulative delay at 30 exceeds
+                      # any configured launch timeout's first check).
+                      max_retries: int = 30,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -63,7 +73,15 @@ def build_controllers(client: Client, cloudprovider,
     (both GC directions, slice-group assignment) run on shard 0 only.
     Every shard watches the full stream (the apiserver fans out watches
     anyway); the partition costs one crc32 per event. Nodes without a
-    pool label fall to shard 0 so nothing is orphaned."""
+    pool label fall to shard 0 so nothing is orphaned.
+
+    ``reconcile_timeout``/``max_retries`` apply the runtime hardening to
+    every per-object controller (singletons are self-requeuing and own
+    their cadence): a hung reconcile is cancelled at the deadline, and a
+    persistently-failing item degrades to slow retry after ``max_retries``
+    requeues — both are counted in the tpu_provisioner_reconcile_* metric
+    families, and retry exhaustion on a NodeClaim also publishes a Warning
+    event on the claim."""
     if not 0 <= shard_index < shards:
         raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
     owns = (lambda name: True) if shards == 1 else \
@@ -88,12 +106,15 @@ def build_controllers(client: Client, cloudprovider,
     termination = NodeTerminationController(client, cloudprovider, eviction,
                                             recorder, termination_options)
 
+    hardening = dict(reconcile_timeout=reconcile_timeout,
+                     max_retries=max_retries)
     controllers = [
         Controller(lifecycle.NAME, lifecycle,
-                   max_concurrent=max_concurrent_reconciles)
+                   max_concurrent=max_concurrent_reconciles, **hardening)
         .watches(NodeClaim, map_fn=claim_map)
         .watches(Node, map_fn=node_claim_map),
-        Controller(termination.NAME, termination, max_concurrent=16)
+        Controller(termination.NAME, termination, max_concurrent=16,
+                   **hardening)
         .watches(Node, map_fn=node_map),
     ]
     if shard_index == 0:
@@ -107,7 +128,7 @@ def build_controllers(client: Client, cloudprovider,
                        max_concurrent=1).as_singleton(),
             Controller(SliceGroupController.NAME,
                        SliceGroupController(client, cluster=cluster),
-                       max_concurrent=4)
+                       max_concurrent=4, **hardening)
             .watches(Node, map_fn=group_requests)
             .watches(NodeClaim, map_fn=group_requests),
         ]
@@ -115,6 +136,32 @@ def build_controllers(client: Client, cloudprovider,
     if node_repair and cloudprovider.repair_policies():
         health = NodeHealthController(client, cloudprovider, recorder, health_options)
         controllers.append(
-            Controller(health.NAME, health, max_concurrent=8)
+            Controller(health.NAME, health, max_concurrent=8, **hardening)
             .watches(Node, map_fn=node_map))
+    exhausted_hook = _make_exhausted_hook(client, recorder)
+    for c in controllers:
+        c.set_metrics_hook(_reconcile_metrics_hook)
+        c.set_exhausted_hook(exhausted_hook)
     return controllers, eviction
+
+
+def _reconcile_metrics_hook(controller: str, duration: float,
+                            err: Optional[str]) -> None:
+    if err == "ReconcileTimeout":
+        RECONCILE_TIMEOUTS.labels(controller).inc()
+
+
+def _make_exhausted_hook(client: Client, recorder: Optional[Recorder]):
+    async def hook(controller: str, req, failures: int) -> None:
+        RECONCILE_RETRIES_EXHAUSTED.labels(controller).inc()
+        if recorder is None:
+            return
+        try:
+            nc = await client.get(NodeClaim, req.name)
+        except Exception:  # noqa: BLE001 — Node-keyed or deleted: no event
+            return
+        await recorder.publish(
+            nc, "Warning", "ReconcileRetriesExhausted",
+            f"{controller} gave up fast retries after {failures} failures; "
+            f"degrading to slow retry")
+    return hook

@@ -60,6 +60,16 @@ class EnvtestOptions:
     # so fleet-scale runs exercise (and size) the cache; unit tests keep the
     # raw client's read-your-writes simplicity.
     use_informer: bool = False
+    # Chaos injection (chaos.ChaosPolicy or a profile built by
+    # chaos.profile(name, seed)): wired into the fake cloud APIs and, for
+    # kube.* rules, a ChaosClient wrapped around the client handed to the
+    # provider/controllers. env.client stays raw so test assertions and
+    # helpers never see injected faults.
+    chaos: object = None
+    # Runtime hardening knobs (runtime/controller.py): per-reconcile
+    # deadline and per-item retry bound for the per-object controllers.
+    reconcile_timeout: Optional[float] = None
+    max_reconcile_retries: int = 30
 
 
 class Env:
@@ -76,12 +86,19 @@ class Env:
             delete_latency=self.opts.delete_latency,
             node_join_delay=self.opts.node_join_delay,
             node_ready_delay=self.opts.node_ready_delay,
-            qr_step_latency=self.opts.qr_step_latency)
+            qr_step_latency=self.opts.qr_step_latency,
+            chaos=self.opts.chaos)
+        self.chaos = self.opts.chaos
         kube = self.client
+        if self.chaos is not None:
+            from .chaos import ChaosClient
+            kube = ChaosClient(self.client, self.chaos)
         self.informers = None
         if self.opts.use_informer:
             from .runtime.informer import CachedListClient
-            kube = CachedListClient(self.client, (Node, NodeClaim))
+            # layered over the (possibly chaos-wrapped) client: informer
+            # re-lists then feel injected apiserver weather too
+            kube = CachedListClient(kube, (Node, NodeClaim))
             self.informers = kube
         self.provider = InstanceProvider(
             self.cloud.nodepools, kube,
@@ -100,7 +117,9 @@ class Env:
             health_options=HealthOptions(
                 max_unhealthy_fraction=self.opts.repair_max_unhealthy_fraction),
             max_concurrent_reconciles=self.opts.max_concurrent_reconciles,
-            shards=self.opts.shards, shard_index=self.opts.shard_index)
+            shards=self.opts.shards, shard_index=self.opts.shard_index,
+            reconcile_timeout=self.opts.reconcile_timeout,
+            max_retries=self.opts.max_reconcile_retries)
         self.manager = Manager(self.client).register(*controllers)
 
     async def __aenter__(self) -> "Env":

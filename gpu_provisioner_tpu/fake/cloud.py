@@ -7,8 +7,12 @@ pool transitions PROVISIONING→RUNNING after ``create_latency``, then each
 host's kubelet "joins" by materializing a Node object (unready → Ready after
 ``node_ready_delay``) with GKE + tpu.kaito.sh labels, the way
 fake/k8sClient.go:210-241 fabricates Ready nodes with agentpool labels and
-VMSS providerIDs. Error injection mirrors AtomicError/MaxCalls
-(fake/atomic.go): ``fail(method, error, times)``.
+VMSS providerIDs. Error injection is two-layer: scripted one-shot faults
+mirroring AtomicError/MaxCalls (fake/atomic.go) via ``fail(method, error,
+times)``, and policy-driven chaos (``chaos.ChaosPolicy``) for probabilistic
+errors, latency/hangs, and partial-failure modes — pools whose nodes never
+join, queued resources wedged mid-ladder, LROs whose ``result()`` raises
+after ``done()``.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from ..apis.core import Node
 from ..catalog import lookup as catalog_lookup
 from ..providers.gcp import (
     APIError, NodePool, QueuedResource,
-    NP_PROVISIONING, NP_RUNNING, NP_STOPPING,
+    NP_ERROR, NP_PROVISIONING, NP_RUNNING, NP_STOPPING,
     QR_ACCEPTED, QR_ACTIVE, QR_CREATING, QR_WAITING,
 )
 from ..providers.instance import instance_name, provider_id
@@ -61,9 +65,16 @@ class TimedOperation:
 
 
 class _FaultInjector:
+    """Scripted one-shot faults + policy-driven chaos, shared by both fake
+    APIs. ``scope`` namespaces this API's methods in chaos rule matching
+    (``nodepools.begin_create`` etc.)."""
+
+    scope = "fake"
+
     def __init__(self):
         self._faults: dict[str, list[tuple[Exception, int]]] = defaultdict(list)
         self.calls: dict[str, int] = defaultdict(int)
+        self.chaos = None  # Optional[chaos.ChaosPolicy], set via FakeCloud
 
     def fail(self, method: str, error: Exception, times: int = 1) -> None:
         self._faults[method].append((error, times))
@@ -79,20 +90,44 @@ class _FaultInjector:
                 faults[0] = (error, times - 1)
             raise error
 
+    async def _acheck(self, method: str) -> None:
+        """Scripted faults first (tests that program an exact failure keep
+        exact semantics), then the chaos policy's probabilistic layer."""
+        self._check(method)
+        if self.chaos is not None:
+            await self.chaos.before_call(self.scope, method)
+
 
 class FakeNodePoolsAPI(_FaultInjector):
+    scope = "nodepools"
+
     def __init__(self, cloud: "FakeCloud"):
         super().__init__()
         self.cloud = cloud
         self.pools: dict[str, NodePool] = {}
 
     async def begin_create(self, pool: NodePool):
-        self._check("begin_create")
+        await self._acheck("begin_create")
         if pool.name in self.pools and self.pools[pool.name].status == NP_PROVISIONING:
             raise APIError(f"operation on {pool.name} already in progress", code=409)
         stored = NodePool.from_dict(pool.to_dict())
         stored.status = NP_PROVISIONING
         self.pools[pool.name] = stored
+
+        # Chaos partial mode: the LRO "completes" but result() raises and the
+        # pool is a dead ERROR carcass with no nodes — the caller's retry
+        # must replace it (begin_create on a non-PROVISIONING pool), not
+        # duplicate it.
+        if self.chaos is not None and self.chaos.should(
+                "op_error", pool.name, per_attempt=True):
+            async def fail_finish():
+                if self.pools.get(pool.name) is stored:
+                    stored.status = NP_ERROR
+                    stored.status_message = "chaos: create operation failed"
+            return TimedOperation(
+                self.cloud.create_latency, on_done=fail_finish,
+                error=APIError(f"chaos: operation on {pool.name} failed",
+                               code=500))
 
         async def finish():
             if self.pools.get(pool.name) is stored:
@@ -102,14 +137,14 @@ class FakeNodePoolsAPI(_FaultInjector):
         return TimedOperation(self.cloud.create_latency, result=stored, on_done=finish)
 
     async def get(self, name: str) -> NodePool:
-        self._check("get")
+        await self._acheck("get")
         pool = self.pools.get(name)
         if pool is None:
             raise APIError(f"nodepool {name} not found", code=404)
         return NodePool.from_dict(pool.to_dict())
 
     async def begin_delete(self, name: str):
-        self._check("begin_delete")
+        await self._acheck("begin_delete")
         pool = self.pools.get(name)
         if pool is None:
             raise APIError(f"nodepool {name} not found", code=404)
@@ -123,7 +158,7 @@ class FakeNodePoolsAPI(_FaultInjector):
         return TimedOperation(self.cloud.delete_latency, on_done=finish)
 
     async def list(self) -> list[NodePool]:
-        self._check("list")
+        await self._acheck("list")
         return [NodePool.from_dict(p.to_dict()) for p in self.pools.values()]
 
 
@@ -133,6 +168,8 @@ class FakeQueuedResourcesAPI(_FaultInjector):
 
     _LADDER = [QR_ACCEPTED, QR_WAITING, QR_CREATING, QR_ACTIVE]
 
+    scope = "queuedresources"
+
     def __init__(self, cloud: "FakeCloud"):
         super().__init__()
         self.cloud = cloud
@@ -140,7 +177,7 @@ class FakeQueuedResourcesAPI(_FaultInjector):
         self._created_at: dict[str, float] = {}
 
     async def create(self, qr: QueuedResource) -> QueuedResource:
-        self._check("create")
+        await self._acheck("create")
         if qr.name in self.resources:
             raise APIError(f"queued resource {qr.name} exists", code=409)
         self.resources[qr.name] = qr
@@ -148,7 +185,7 @@ class FakeQueuedResourcesAPI(_FaultInjector):
         return qr
 
     async def get(self, name: str) -> QueuedResource:
-        self._check("get")
+        await self._acheck("get")
         qr = self.resources.get(name)
         if qr is None:
             raise APIError(f"queued resource {name} not found", code=404)
@@ -156,13 +193,13 @@ class FakeQueuedResourcesAPI(_FaultInjector):
         return qr
 
     async def delete(self, name: str) -> None:
-        self._check("delete")
+        await self._acheck("delete")
         if self.resources.pop(name, None) is None:
             raise APIError(f"queued resource {name} not found", code=404)
         self._created_at.pop(name, None)
 
     async def list(self) -> list[QueuedResource]:
-        self._check("list")
+        await self._acheck("list")
         for qr in self.resources.values():
             self._auto_advance(qr)
         return list(self.resources.values())
@@ -170,9 +207,14 @@ class FakeQueuedResourcesAPI(_FaultInjector):
     def _auto_advance(self, qr: QueuedResource) -> None:
         if qr.state not in self._LADDER:
             return  # SUSPENDED/FAILED are terminal until test flips them
+        # Chaos partial mode: wedged mid-ladder — reaches CREATING and stays
+        # there forever (the Cloud TPU stuck-PROVISIONING pathology).
+        ceiling = len(self._LADDER) - 1
+        if self.chaos is not None and self.chaos.should("qr_stuck", qr.name):
+            ceiling = self._LADDER.index(QR_CREATING)
         elapsed = time.monotonic() - self._created_at.get(qr.name, 0)
         steps = int(elapsed / self.cloud.qr_step_latency) if self.cloud.qr_step_latency else len(self._LADDER)
-        idx = min(self._LADDER.index(QR_ACCEPTED) + steps, len(self._LADDER) - 1)
+        idx = min(self._LADDER.index(QR_ACCEPTED) + steps, ceiling)
         current = self._LADDER.index(qr.state)
         qr.state = self._LADDER[max(idx, current)]
 
@@ -190,7 +232,8 @@ class FakeCloud:
                  create_latency: float = 0.05, delete_latency: float = 0.02,
                  node_join_delay: float = 0.0, node_ready_delay: float = 0.0,
                  qr_step_latency: float = 0.02,
-                 leave_orphan_nodes: bool = False):
+                 leave_orphan_nodes: bool = False,
+                 chaos=None):
         self.kube = kube
         self.project, self.zone, self.cluster = project, zone, cluster
         self.create_latency = create_latency
@@ -202,10 +245,21 @@ class FakeCloud:
         self.nodepools = FakeNodePoolsAPI(self)
         self.queuedresources = FakeQueuedResourcesAPI(self)
         self._join_tasks: list[asyncio.Task] = []
+        self.chaos = None
+        if chaos is not None:
+            self.set_chaos(chaos)
+
+    def set_chaos(self, policy) -> None:
+        """Attach a ``chaos.ChaosPolicy`` to every fake API at once."""
+        self.chaos = policy
+        self.nodepools.chaos = policy
+        self.queuedresources.chaos = policy
 
     async def join_nodes(self, pool: NodePool) -> None:
         """Simulate each host's kubelet joining: Node objects appear with
         providerIDs + GKE/topology labels, unready first, Ready after delay."""
+        if self.chaos is not None and self.chaos.should("no_join", pool.name):
+            return  # chaos: pool RUNNING, kubelets never phone home
         shape = catalog_lookup(pool.config.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
         capacity = (shape.per_host_capacity() if shape
                     else {wk.TPU_RESOURCE_NAME: "1", "cpu": "96", "memory": "448Gi"})

@@ -25,6 +25,7 @@ from ..apis.meta import _KINDS
 # imported for its side effect: registers the karpenter_cloudprovider_*
 # metric families so /metrics always exposes them, whatever the import order
 from ..cloudprovider import metrics as _cloudprovider_metrics  # noqa: F401
+from ..controllers.metrics import update_runtime_gauges
 from ..runtime.controller import Manager
 
 
@@ -48,6 +49,9 @@ def build_apps(manager: Manager, enable_profiling: bool = False):
     metrics = web.Application()
 
     async def metrics_handler(_req):
+        # sample workqueue depth/backlog + circuit-breaker state at scrape
+        # time — these live in runtime objects, not prometheus counters
+        update_runtime_gauges(manager)
         return web.Response(body=generate_latest(),
                             content_type=CONTENT_TYPE_LATEST.split(";")[0])
 

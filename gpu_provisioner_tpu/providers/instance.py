@@ -485,7 +485,19 @@ class InstanceProvider:
     # ------------------------------------------------------------- delete
     async def delete(self, name: str) -> None:
         """Get-first delete: skip if already Deleting, map NotFound →
-        NodeClaimNotFoundError (armutils.go:42-76)."""
+        NodeClaimNotFoundError (armutils.go:42-76).
+
+        Queued-resource cleanup runs FIRST and unconditionally: a claim can
+        die before its pool ever exists — queued capacity stuck in the
+        stockout ladder until launch liveness reaps the claim — and keying
+        the cleanup off a successful pool get would leak that queued
+        resource forever (found by the stuck-queue chaos profile)."""
+        if self.queued is not None:
+            try:
+                await self.queued.delete(name)
+            except APIError as e:
+                if not e.not_found:
+                    raise
         try:
             pool = await self.nodepools.get(name)
         except APIError as e:
@@ -495,12 +507,6 @@ class InstanceProvider:
         if pool.status == NP_STOPPING:
             log.info("nodepool %s already deleting, skipping", name)
             return
-        if self.queued is not None:
-            try:
-                await self.queued.delete(name)
-            except APIError as e:
-                if not e.not_found:
-                    raise
         try:
             op = await self.nodepools.begin_delete(name)
             await poll_until_done(op)

@@ -25,8 +25,9 @@ from typing import Optional
 import httpx
 
 from ..auth.credentials import Credentials
-from ..transport import (GCP_RETRYABLE_STATUS, TransportOptions,
-                         build_http_client, request_with_retries)
+from ..transport import (GCP_RETRYABLE_STATUS, BreakerOpenError,
+                         CircuitBreaker, TransportOptions, build_http_client,
+                         request_with_retries)
 from .gcp import (APIError, NodePool, Operation, QueuedResource,
                   QueuedResourcesAPI, QR_ACCEPTED)
 
@@ -50,16 +51,29 @@ class _AuthedREST:
             self.topts = replace(self.topts,
                                  retryable_status=GCP_RETRYABLE_STATUS)
         self.http = http or build_http_client(self.topts)
+        # One breaker per endpoint: a down GKE API must not blind the TPU
+        # API client (and vice versa). State is exported on /metrics.
+        self.breaker = CircuitBreaker(
+            name=httpx.URL(self.endpoint).host or self.endpoint,
+            failure_threshold=self.topts.breaker_threshold,
+            reset_timeout=self.topts.breaker_reset)
 
     async def aclose(self) -> None:
+        self.breaker.unregister()
         await self.http.aclose()
 
     async def req(self, method: str, path: str, **kw) -> dict:
         headers = {"Authorization": f"Bearer {await self.cred.token()}",
                    "Content-Type": "application/json"}
-        resp = await request_with_retries(
-            self.http, method, f"{self.endpoint}{path}", opts=self.topts,
-            headers=headers, **kw)
+        try:
+            resp = await request_with_retries(
+                self.http, method, f"{self.endpoint}{path}", opts=self.topts,
+                breaker=self.breaker, headers=headers, **kw)
+        except BreakerOpenError as e:
+            # Surface as a retryable 503: instance/controller code maps it
+            # into CreateError → rate-limited requeue, so a down cloud API
+            # costs one local exception per reconcile, not a retry storm.
+            raise APIError(str(e), code=503) from e
         if resp.status_code >= 400:
             raise APIError(resp.text[:512], code=resp.status_code)
         return resp.json() if resp.content else {}
@@ -113,6 +127,10 @@ class GKENodePoolsClient:
         self.parent = (f"/projects/{project}/locations/{location}"
                        f"/clusters/{cluster}")
         self.ops_path = f"/projects/{project}/locations/{location}/operations"
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self.rest.breaker
 
     async def aclose(self) -> None:
         await self.rest.aclose()
@@ -211,6 +229,10 @@ class CloudTPUQueuedResourcesClient:
         self.rest = _AuthedREST(cred, endpoint, transport, http)
         self.parent = f"/projects/{project}/locations/{location}"
         self.runtime_version = runtime_version
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self.rest.breaker
 
     async def aclose(self) -> None:
         await self.rest.aclose()

@@ -60,16 +60,40 @@ SINGLETON_REQUEST = Request(name="singleton")
 
 
 class Controller:
-    """One reconcile loop: watch sources → workqueue → N workers."""
+    """One reconcile loop: watch sources → workqueue → N workers.
 
-    def __init__(self, name: str, reconciler: Reconciler, max_concurrent: int = 10):
+    Robustness hardening (chaos-suite-driven):
+
+    - ``reconcile_timeout``: per-reconcile deadline. A hung reconcile (cloud
+      call that never returns, wedged poll loop) is cancelled at the
+      deadline, counted, and rate-limit-requeued — it costs one worker for
+      ``reconcile_timeout`` seconds, not forever.
+    - ``max_retries``: per-item retry bound. After N consecutive
+      rate-limited requeues the controller emits a warning (+ the
+      ``reconcile_retries_exhausted`` metric via the exhausted hook),
+      resets the failure counter — keeping the backoff cadence pinned at
+      the cap, so the fast ladder does NOT restart — and requeues at the
+      queue's max delay. With no informer resync in this runtime, dropping
+      the item outright would wedge the object until an unrelated watch
+      event; the slow-poll keeps liveness/GC able to converge it while
+      staying O(1) calls per max_delay window. 0 disables the bound.
+    """
+
+    def __init__(self, name: str, reconciler: Reconciler, max_concurrent: int = 10,
+                 reconcile_timeout: Optional[float] = None,
+                 max_retries: int = 0):
         self.name = name
         self.reconciler = reconciler
         self.max_concurrent = max_concurrent
+        self.reconcile_timeout = reconcile_timeout
+        self.max_retries = max_retries
         self.queue = RateLimitingQueue()
         self.sources: list[_Source] = []
         self.singleton = False
+        self.timeouts_total = 0
+        self.retries_exhausted_total = 0
         self._metrics_hook: Optional[Callable[[str, float, Optional[str]], None]] = None
+        self._exhausted_hook: Optional[Callable[[str, Request, int], Awaitable[None]]] = None
 
     def watches(self, cls: type, map_fn: Optional[MapFn] = None,
                 predicate: Optional[Predicate] = None) -> "Controller":
@@ -83,6 +107,12 @@ class Controller:
     def set_metrics_hook(self, hook) -> None:
         self._metrics_hook = hook
 
+    def set_exhausted_hook(self, hook) -> None:
+        """Async ``hook(controller_name, req, failures)`` fired when an item
+        exhausts ``max_retries`` (events/metrics live above the runtime
+        layer; this seam keeps the dependency pointing upward)."""
+        self._exhausted_hook = hook
+
     # -- run --------------------------------------------------------------
     async def _pump(self, client: Client, src: _Source) -> None:
         w = client.watch(src.cls)
@@ -95,21 +125,72 @@ class Controller:
         finally:
             w.close()
 
+    async def _reconcile_once(self, req: Request) -> Result:
+        if self.reconcile_timeout is None:
+            return await self.reconciler.reconcile(req)
+        # wait_for CANCELS the hung reconcile at the deadline — the worker
+        # is reclaimed; the item takes the normal error-backoff path.
+        return await asyncio.wait_for(self.reconciler.reconcile(req),
+                                      timeout=self.reconcile_timeout)
+
+    async def _requeue_failed(self, req: Request) -> None:
+        """Error path: rate-limited requeue, bounded by ``max_retries``."""
+        failures = self.queue.num_requeues(req)
+        if self.max_retries and failures >= self.max_retries:
+            self.retries_exhausted_total += 1
+            log.warning(
+                "controller=%s req=%s retries exhausted after %d attempts; "
+                "degrading to slow retry every %.0fs", self.name, req,
+                failures, self.queue.max_delay)
+            if self._exhausted_hook is not None:
+                try:
+                    await self._exhausted_hook(self.name, req, failures)
+                except Exception:  # noqa: BLE001 — observability only
+                    log.warning("controller=%s exhausted hook failed",
+                                self.name, exc_info=True)
+            await self.queue.reset_failures(req)
+            await self.queue.add_after(req, self.queue.max_delay)
+            return
+        await self.queue.add_rate_limited(req)
+
     async def _worker(self) -> None:
         while True:
             req = await self.queue.get()
             start = time.monotonic()
             err: Optional[str] = None
             try:
-                result = await self.reconciler.reconcile(req)
+                result = await self._reconcile_once(req)
             except asyncio.CancelledError:
-                raise
-            except Exception as e:  # reconcile errors → rate-limited requeue
-                err = type(e).__name__
-                log.warning("controller=%s req=%s reconcile error: %s",
-                            self.name, req, e, exc_info=True)
+                # Shutdown cancellation must propagate; a CancelledError the
+                # RECONCILER leaked (a sub-task it spawned got cancelled) is
+                # isolated and retried. Task.cancelling() is 3.11+ — on 3.10
+                # the two are indistinguishable, so re-raise (pre-hardening
+                # behavior).
+                cancelling = getattr(asyncio.current_task(), "cancelling", None)
+                if cancelling is None or cancelling():
+                    raise
+                err = "Cancelled"
                 await self.queue.done(req)
-                await self.queue.add_rate_limited(req)
+                await self._requeue_failed(req)
+            except Exception as e:  # reconcile errors → rate-limited requeue
+                # TimeoutError with a deadline configured = OUR wait_for
+                # fired (3.11+: asyncio.TimeoutError IS builtin TimeoutError;
+                # a reconciler-raised timeout with no deadline set stays a
+                # generic error).
+                if (isinstance(e, asyncio.TimeoutError)
+                        and self.reconcile_timeout is not None):
+                    err = "ReconcileTimeout"
+                    self.timeouts_total += 1
+                    log.warning(
+                        "controller=%s req=%s reconcile exceeded %.1fs "
+                        "deadline; cancelled and requeued", self.name, req,
+                        self.reconcile_timeout)
+                else:
+                    err = type(e).__name__
+                    log.warning("controller=%s req=%s reconcile error: %s",
+                                self.name, req, e, exc_info=True)
+                await self.queue.done(req)
+                await self._requeue_failed(req)
             else:
                 await self.queue.forget(req)
                 await self.queue.done(req)

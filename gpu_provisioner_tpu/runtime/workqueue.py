@@ -3,23 +3,33 @@
 Semantics replicated from client-go, which every reference controller relies
 on: an item present in the queue is not added twice; an item re-added while a
 worker is processing it is re-queued after ``done``; ``add_rate_limited``
-applies per-item exponential backoff (5 ms → 1000 s, client-go's default
-failure rate limiter) cleared by ``forget``.
+applies per-item backoff (5 ms → 1000 s window, client-go's default failure
+rate limiter) cleared by ``forget``. The backoff uses decorrelated jitter
+(delay ~ U(base, 3·previous), capped) rather than bare ``base·2**n``: a
+fleet of items that failed together — one cloud outage fails every in-flight
+create in the same second — must not come back as a synchronized retry wave
+on every subsequent cycle.
 """
 
 from __future__ import annotations
 
 import asyncio
 import heapq
+import random
 import time
 from collections import deque
 from typing import Any, Hashable, Optional
 
 
 class RateLimitingQueue:
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0,
+                 seed: Optional[int] = None):
         self.base_delay = base_delay
         self.max_delay = max_delay
+        # Seedable for deterministic chaos/soak tests; None → os entropy.
+        self._rng = random.Random(seed)
+        self._last_delay: dict[Hashable, float] = {}
+        self.requeues_total = 0
         # deque: get() pops from the FRONT — list.pop(0) is O(depth)
         # and a fleet wave holds thousands of ready items
         self._queue: deque[Hashable] = deque()
@@ -88,9 +98,19 @@ class RateLimitingQueue:
 
     async def add_rate_limited(self, item: Hashable) -> None:
         async with self._cond:
-            n = self._failures.get(item, 0)
-            self._failures[item] = n + 1
-        await self.add_after(item, min(self.base_delay * (2 ** n), self.max_delay))
+            self._failures[item] = self._failures.get(item, 0) + 1
+            # Decorrelated jitter (the AWS-architecture-blog variant):
+            # sleep = min(cap, U(base, 3·prev)). Grows like the exponential
+            # ladder in expectation but two items that failed in the same
+            # instant immediately diverge instead of retrying in lockstep
+            # forever.
+            prev = self._last_delay.get(item, self.base_delay)
+            delay = min(self.max_delay,
+                        self._rng.uniform(self.base_delay,
+                                          max(prev * 3, self.base_delay)))
+            self._last_delay[item] = delay
+            self.requeues_total += 1
+        await self.add_after(item, delay)
 
     def num_requeues(self, item: Hashable) -> int:
         return self._failures.get(item, 0)
@@ -98,6 +118,30 @@ class RateLimitingQueue:
     async def forget(self, item: Hashable) -> None:
         async with self._cond:
             self._failures.pop(item, None)
+            self._last_delay.pop(item, None)
+
+    async def reset_failures(self, item: Hashable) -> None:
+        """Clear the failure COUNTER but keep the jitter memory: the next
+        ``add_rate_limited`` continues at the current (capped) cadence
+        instead of restarting the fast ladder. Used by the controller's
+        retry-exhaustion degrade path — a full ``forget`` there would turn
+        "degrade to slow retry" into a sawtooth retry storm."""
+        async with self._cond:
+            self._failures.pop(item, None)
+
+    # -- observability (exported as gauges via controllers/metrics.py) ----
+    def depth(self) -> int:
+        """Items ready for a worker right now."""
+        return len(self._queue)
+
+    def delayed(self) -> int:
+        """Items parked in backoff."""
+        return len(self._delayed)
+
+    def retrying(self) -> int:
+        """Items with a live failure count (requeued at least once since
+        their last forget)."""
+        return len(self._failures)
 
     def _drain_delayed_locked(self) -> Optional[float]:
         """Move due delayed items into the queue; return seconds to next due."""

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass
+from typing import Optional
 
 import httpx
 
@@ -19,6 +21,123 @@ RETRYABLE_STATUS = frozenset({408, 429, 500, 502, 503, 504})
 # InsufficientCapacity lifecycle path), not throttling — never eat it in the
 # transport; the kube apiserver's 429 IS throttling and stays retryable.
 GCP_RETRYABLE_STATUS = RETRYABLE_STATUS - {429}
+
+# Statuses that count against the circuit breaker: server-side failure, not
+# semantic answers (4xx incl. 429 are the API *working* and saying no).
+BREAKER_FAILURE_STATUS = frozenset({500, 502, 503, 504, 408})
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+# name → breaker, for metrics export (controllers/metrics.py reads this when
+# /metrics is scraped). Re-creating a breaker under the same name replaces
+# the entry — the newest client owns the gauge.
+BREAKERS: dict[str, "CircuitBreaker"] = {}
+
+
+class BreakerOpenError(Exception):
+    """The circuit breaker refused the call without touching the network.
+
+    Carries ``retry_after`` (seconds until the next half-open probe) so
+    callers can requeue with a sensible delay instead of busy-looping."""
+
+    def __init__(self, name: str, retry_after: float):
+        super().__init__(
+            f"circuit breaker {name!r} is open; next probe in "
+            f"{max(retry_after, 0):.1f}s")
+        self.name = name
+        self.retry_after = max(retry_after, 0.0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probing.
+
+    Counts only server-side failures (5xx/408/transport errors). After
+    ``failure_threshold`` consecutive failures the breaker opens: calls are
+    rejected locally (``BreakerOpenError``) for ``reset_timeout`` seconds,
+    then ONE probe is let through (half-open); its outcome closes or
+    re-opens the breaker. Single-event-loop discipline: no awaits between
+    check and mutate, so no lock is needed.
+    """
+
+    def __init__(self, name: str = "default", failure_threshold: int = 5,
+                 reset_timeout: float = 30.0, clock=time.monotonic):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        # observability (exported via controllers/metrics.py)
+        self.rejected_total = 0
+        self.opened_total = 0
+        BREAKERS[name] = self
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return BREAKER_CLOSED
+        if self._clock() - self._opened_at >= self.reset_timeout:
+            return BREAKER_HALF_OPEN
+        return BREAKER_OPEN
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._failures
+
+    def retry_after(self) -> float:
+        if self._opened_at is None:
+            return 0.0
+        return self.reset_timeout - (self._clock() - self._opened_at)
+
+    def allow(self) -> bool:
+        state = self.state
+        if state == BREAKER_CLOSED:
+            return True
+        if state == BREAKER_HALF_OPEN:
+            # One probe per window — but a probe whose outcome was never
+            # recorded (caller cancelled mid-flight, process hiccup) must
+            # not wedge the breaker half-open forever: after a full reset
+            # window with no verdict, admit a fresh probe.
+            stale = (self._probe_inflight
+                     and self._clock() - self._probe_started >= self.reset_timeout)
+            if not self._probe_inflight or stale:
+                self._probe_inflight = True
+                self._probe_started = self._clock()
+                return True
+        self.rejected_total += 1
+        return False
+
+    def release_probe(self) -> None:
+        """The in-flight probe ended without an HTTP verdict (cancellation,
+        unexpected exception): free the probe slot so the next caller can
+        probe, without judging the endpoint either way."""
+        self._probe_inflight = False
+
+    def record_success(self) -> None:
+        self._failures = 0
+        self._opened_at = None
+        self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        self._failures += 1
+        if self._probe_inflight:
+            # failed probe: re-open for a fresh window
+            self._opened_at = self._clock()
+            self._probe_inflight = False
+        elif (self._opened_at is None
+                and self._failures >= self.failure_threshold):
+            self._opened_at = self._clock()
+            self.opened_total += 1
+
+    def unregister(self) -> None:
+        """Drop this breaker from the metrics registry (client close): stale
+        entries would keep exporting state no live client gates on."""
+        if BREAKERS.get(self.name) is self:
+            del BREAKERS[self.name]
 
 
 @dataclass
@@ -30,6 +149,8 @@ class TransportOptions:
     timeout: float = 60.0
     user_agent: str = "tpu-provisioner"
     retryable_status: frozenset[int] = RETRYABLE_STATUS
+    breaker_threshold: int = 5     # consecutive 5xx/timeouts before opening
+    breaker_reset: float = 30.0    # seconds open before a half-open probe
 
 
 def build_http_client(opts: TransportOptions | None = None,
@@ -45,21 +166,48 @@ def build_http_client(opts: TransportOptions | None = None,
 
 async def request_with_retries(http: httpx.AsyncClient, method: str, url: str,
                                opts: TransportOptions | None = None,
+                               breaker: Optional[CircuitBreaker] = None,
                                **kw) -> httpx.Response:
     """Issue a request, retrying transient failures with capped exponential
     backoff. Any response that is not retryable — and the LAST response when
     the retry budget runs out — is returned as-is: the caller owns error
     taxonomy mapping (e.g. 429 → InsufficientCapacity must survive the
-    transport). Only exhausted transport-level failures raise."""
+    transport). Only exhausted transport-level failures raise.
+
+    With a ``breaker``, every attempt must pass it first: once consecutive
+    5xx/timeouts open it, the retry loop stops hammering the endpoint and
+    raises ``BreakerOpenError`` immediately — the caller requeues with
+    backoff while the breaker's half-open probes watch for recovery. The
+    breaker counts PER-ATTEMPT, so with a threshold below ``max_retries`` a
+    sustained failure surfaces after ``breaker_threshold`` attempts rather
+    than marathoning through the whole retry budget — deliberate: the
+    workqueue's backoff owns the long wait, not a parked worker. Blips
+    shorter than the threshold still heal in-loop (any success resets)."""
     opts = opts or TransportOptions()
     last_exc: Exception | None = None
     last_resp: httpx.Response | None = None
     for attempt in range(opts.max_retries + 1):
+        if breaker is not None and not breaker.allow():
+            raise BreakerOpenError(breaker.name, breaker.retry_after())
         try:
             resp = await http.request(method, url, **kw)
         except (httpx.TransportError, httpx.TimeoutException) as e:
             last_exc, last_resp = e, None
+            if breaker is not None:
+                breaker.record_failure()
+        except BaseException:
+            # No HTTP verdict (CancelledError from a reconcile deadline,
+            # anything unexpected): don't judge the endpoint, but free the
+            # half-open probe slot or the breaker wedges half-open forever.
+            if breaker is not None:
+                breaker.release_probe()
+            raise
         else:
+            if breaker is not None:
+                if resp.status_code in BREAKER_FAILURE_STATUS:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
             if resp.status_code not in opts.retryable_status:
                 return resp
             last_resp = resp

@@ -1,0 +1,470 @@
+"""Chaos soak suite: convergence invariants under named fault profiles.
+
+Every test runs the WHOLE provisioner (envtest) under a seeded
+``chaos.ChaosPolicy`` and asserts the three robustness invariants the fleet
+depends on:
+
+1. every NodeClaim converges — Ready, or correctly terminally deleted;
+2. zero leaked or duplicate cloud resources — node pools and queued
+   resources in the fake cloud exactly match the surviving claims;
+3. zero wedged workqueue items — after convergence no controller queue
+   holds a ready item or a live failure counter.
+
+Profiles are deterministic for a fixed seed (keyed hash draws, not a shared
+RNG stream), so a failure here reproduces with ``CHAOS_SEED=<n> make chaos``.
+"""
+
+import asyncio
+import os
+
+import httpx
+import pytest
+
+from gpu_provisioner_tpu import chaos
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+from gpu_provisioner_tpu.auth.credentials import StaticTokenCredential
+from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.controllers.metrics import (
+    BREAKER_STATE, WORKQUEUE_RETRYING, update_runtime_gauges,
+)
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.providers.gcp import APIError
+from gpu_provisioner_tpu.providers.instance import PROVISIONING_MODE_ANNOTATION
+from gpu_provisioner_tpu.providers.rest import GKENodePoolsClient
+from gpu_provisioner_tpu.runtime.client import NotFoundError
+from gpu_provisioner_tpu.runtime.workqueue import RateLimitingQueue
+from gpu_provisioner_tpu.transport import (
+    BREAKER_CLOSED, BREAKER_OPEN, BREAKERS, BreakerOpenError, CircuitBreaker,
+    TransportOptions, request_with_retries,
+)
+
+from .conftest import async_test
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+def chaos_env(policy, launch_timeout: float = 2.0, **opt_kw) -> Env:
+    """Envtest tuned for soak: fast GC, short liveness budget, and a small
+    queue max_delay so the post-exhaustion slow-retry cadence fits test
+    time (production keeps client-go's 1000s)."""
+    opts = EnvtestOptions(chaos=policy, gc_interval=0.1, leak_grace=0.1,
+                          **opt_kw)
+    opts.lifecycle.launch_timeout = launch_timeout
+    opts.lifecycle.registration_timeout = launch_timeout
+    env = Env(opts)
+    for i, c in enumerate(env.manager.controllers):
+        c.queue.max_delay = 0.5
+        c.queue._rng.seed((SEED << 8) | i)  # reproducible jitter draws
+    return env
+
+
+async def converge(env: Env, names: list[str], timeout: float = 20.0
+                   ) -> tuple[set[str], set[str]]:
+    """Wait until every claim is Ready or gone; returns (ready, gone)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    ready: set[str] = set()
+    gone: set[str] = set()
+    while True:
+        for name in set(names) - ready - gone:
+            try:
+                nc = await env.client.get(NodeClaim, name)
+            except NotFoundError:
+                gone.add(name)
+                continue
+            if nc.status_conditions.is_true(CONDITION_READY):
+                ready.add(name)
+        if ready | gone == set(names):
+            return ready, gone
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(
+                f"claims did not converge: ready={sorted(ready)} "
+                f"gone={sorted(gone)} of {sorted(names)}")
+        await asyncio.sleep(0.05)
+
+
+async def assert_no_leaks_and_drained(env: Env, ready: set[str],
+                                      timeout: float = 10.0) -> None:
+    """The leak + wedge invariants, with a settle loop: deletes/GC for the
+    terminal claims may still be in flight when convergence is observed."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        pools = set(env.cloud.nodepools.pools)
+        qrs = set(env.cloud.queuedresources.resources)
+        queues_ok = all(
+            c.queue.depth() == 0 and c.queue.retrying() == 0
+            for c in env.manager.controllers if not c.singleton)
+        nodes = await env.client.list(Node)
+        node_pools = {n.metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
+                      for n in nodes}
+        if (pools == ready and not qrs and queues_ok
+                and node_pools <= ready | {None}):
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"leak/wedge invariant violated: pools={sorted(pools)} "
+                f"(want {sorted(ready)}), queued={sorted(qrs)} (want none), "
+                f"orphan-node-pools={sorted((node_pools - ready) - {None}, key=str)}, "
+                f"queues_drained={queues_ok}")
+        await asyncio.sleep(0.05)
+
+
+# ------------------------------------------------------------ soak profiles
+
+@async_test
+async def test_soak_flaky_cloud_converges():
+    """20% transient 5xx on every cloud call: everything still reaches
+    Ready, nothing leaks, no queue wedges."""
+    policy = chaos.profile("flaky-cloud", seed=SEED)
+    names = [f"fl{i}" for i in range(6)]
+    async with chaos_env(policy, launch_timeout=10.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert ready == set(names), f"terminal deletions under flake: {gone}"
+        assert policy.injected_total("error:") > 0, "profile injected nothing"
+        await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_stockout_bursts_terminate_cleanly():
+    """First creates hit RESOURCE_EXHAUSTED: exactly those claims are
+    terminally deleted (KAITO's re-shape contract), the rest reach Ready,
+    and the stockout victims leave nothing behind."""
+    policy = chaos.profile("stockout", seed=SEED)
+    names = [f"so{i}" for i in range(5)]
+    async with chaos_env(policy, launch_timeout=10.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        # the profile injects 429 on exactly the first two begin_create calls
+        assert len(gone) == 2, f"want 2 stockout deletions, got {sorted(gone)}"
+        assert policy.injected["error:nodepools.begin_create"] >= 2
+        await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_partial_provision_reaps_doomed_pools():
+    """Pools report RUNNING but kubelets never join for ~half the claims:
+    launch liveness must reap the claims and GC the half-created pools —
+    the dominant orphaned-capacity failure mode."""
+    policy = chaos.profile("partial-provision", seed=SEED)
+    names = [f"pp{i}" for i in range(6)]
+    doomed = {n for n in names if policy._draw("no_join", n) < 0.5}
+    assert 0 < len(doomed) < len(names), \
+        f"seed {SEED} gives a degenerate split; pick another"
+    async with chaos_env(policy, launch_timeout=1.5) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert gone == doomed
+        assert ready == set(names) - doomed
+        await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_stuck_queued_resource_does_not_leak_qr():
+    """Queued capacity wedges mid-ladder (stuck CREATING): liveness reaps
+    the claims and — the leak the chaos suite found — the queued resources
+    must be cleaned up even though no pool ever existed."""
+    policy = chaos.profile("stuck-queue", seed=SEED)
+    names = [f"sq{i}" for i in range(3)]
+    async with chaos_env(policy, launch_timeout=1.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(
+                n, annotations={PROVISIONING_MODE_ANNOTATION: "queued"}))
+        ready, gone = await converge(env, names, timeout=20.0)
+        assert gone == set(names), "stuck queued claims must be reaped"
+        await assert_no_leaks_and_drained(env, set())
+
+
+@async_test
+async def test_soak_operation_result_error_no_duplicate_pools():
+    """LRO done()→result() raises and leaves an ERROR pool carcass: retries
+    must replace the carcass in place — never duplicate, never wedge."""
+    policy = chaos.profile("op-error", seed=SEED)
+    names = [f"oe{i}" for i in range(5)]
+    async with chaos_env(policy, launch_timeout=15.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert ready == set(names), f"op-error must be retried through: {gone}"
+        assert policy.injected_total("op_error:") > 0
+        await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_outage_backoff_bounds_call_rate():
+    """Sustained 100% outage of the node-pool API: claims cannot converge —
+    the invariant is COST. Decorrelated-jitter backoff must keep the cloud
+    call rate O(log) per claim, not a hot loop, and the failure counters
+    must be visible on the workqueue gauges."""
+    policy = chaos.profile("outage", seed=SEED)
+    names = [f"ou{i}" for i in range(4)]
+    async with chaos_env(policy, launch_timeout=60.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        await asyncio.sleep(2.0)
+        calls = env.cloud.nodepools.calls["begin_create"]
+        # hot-looping 4 claims for 2s would be thousands of calls; the
+        # jittered ladder (~1.5× growth per retry, then the 0.5s cap this
+        # suite sets) averages ~17 per claim with a heavy tail — bound at
+        # the tail's ceiling, still an order of magnitude under a storm
+        assert calls <= 40 * len(names), f"retry storm: {calls} creates in 2s"
+        # nothing terminally deleted — 503 is weather, not an answer
+        for n in names:
+            await env.client.get(NodeClaim, n)
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        assert lifecycle.queue.retrying() > 0, "claims should be in backoff"
+        update_runtime_gauges(env.manager)
+        assert (WORKQUEUE_RETRYING.labels("nodeclaim.lifecycle")._value.get()
+                > 0), "backoff state must be visible on the exported gauge"
+
+
+@async_test
+async def test_soak_hang_injection_trips_reconcile_deadline():
+    """Hung cloud calls are cancelled at the per-reconcile deadline, counted,
+    and retried to convergence — a wedged API call must never park a worker
+    forever."""
+    policy = chaos.ChaosPolicy(SEED, rules=[
+        chaos.FaultRule(match="nodepools.begin_create", hang=30.0,
+                        hang_rate=1.0, until=2),
+    ])
+    names = [f"hg{i}" for i in range(3)]
+    async with chaos_env(policy, launch_timeout=20.0,
+                         reconcile_timeout=2.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert ready == set(names)
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        assert lifecycle.timeouts_total >= 1, "deadline never fired"
+        await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_flaky_apiserver_converges():
+    """kube.* chaos: a flaky apiserver (10% transient errors on reads and
+    writes) must also be retried through to full convergence."""
+    policy = chaos.ChaosPolicy(SEED, rules=[
+        chaos.FaultRule(match="kube.*", rate=0.1,
+                        error=chaos.transient_kube()),
+    ])
+    names = [f"ka{i}" for i in range(4)]
+    async with chaos_env(policy, launch_timeout=10.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert ready == set(names)
+        assert policy.injected_total("error:kube") > 0
+        await assert_no_leaks_and_drained(env, ready)
+
+
+# ------------------------------------------------------- retry exhaustion
+
+@async_test
+async def test_retry_exhaustion_emits_warning_and_degrades():
+    """A persistently-failing item stops climbing the backoff ladder after
+    max_retries: warning event + metric, counter forgotten, slow retry
+    cadence — and once the fault clears, the claim still converges."""
+    policy = chaos.ChaosPolicy(SEED, rules=[
+        chaos.FaultRule(match="nodepools.begin_create", rate=1.0, until=6,
+                        error=chaos.transient(503)),
+    ])
+    async with chaos_env(policy, launch_timeout=30.0) as env:
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        lifecycle.max_retries = 3  # exhaust quickly: 6 hard failures ahead
+        await env.client.create(make_nodeclaim("ex0"))
+        nc = await env.wait_ready("ex0", timeout=20)
+        assert nc.status_conditions.is_true(CONDITION_READY)
+        assert lifecycle.retries_exhausted_total >= 1
+        from gpu_provisioner_tpu.apis.core import Event
+        events = await env.client.list(Event)
+        assert any(e.reason == "ReconcileRetriesExhausted" for e in events), \
+            [e.reason for e in events]
+
+
+# ------------------------------------------------------ workqueue jitter
+
+@async_test
+async def test_decorrelated_jitter_desynchronizes_retry_wave():
+    """Items that failed together must not retry in lockstep: with
+    decorrelated jitter the per-item delays diverge; with base*2**n they
+    would be byte-identical."""
+    q = RateLimitingQueue(base_delay=0.01, max_delay=10.0, seed=SEED)
+    items = [f"item{i}" for i in range(8)]
+    for _ in range(4):  # four synchronized failure rounds
+        for it in items:
+            await q.add_rate_limited(it)
+        while q.delayed() or len(q):
+            try:
+                got = await asyncio.wait_for(q.get(), 5)
+            except asyncio.TimeoutError:
+                break
+            await q.done(got)
+    delays = {round(q._last_delay[it], 6) for it in items}
+    assert len(delays) > len(items) // 2, \
+        f"retry wave stayed synchronized: {delays}"
+    assert all(q._last_delay[it] <= 10.0 for it in items)
+    assert q.requeues_total == 4 * len(items)
+    await q.forget("item0")
+    assert "item0" not in q._last_delay and q.num_requeues("item0") == 0
+
+
+# ------------------------------------------------------- circuit breaker
+
+def test_circuit_breaker_state_machine():
+    t = {"now": 0.0}
+    b = CircuitBreaker("t", failure_threshold=3, reset_timeout=10.0,
+                       clock=lambda: t["now"])
+    assert b.state == BREAKER_CLOSED and b.allow()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == BREAKER_OPEN
+    assert not b.allow() and b.rejected_total == 1
+    t["now"] = 10.1                       # half-open: exactly one probe
+    assert b.allow()
+    assert not b.allow(), "second probe must be rejected"
+    b.record_failure()                    # probe failed → re-open
+    assert b.state == BREAKER_OPEN and not b.allow()
+    t["now"] = 20.3
+    assert b.allow()
+    b.record_success()                    # probe succeeded → closed
+    assert b.state == BREAKER_CLOSED and b.consecutive_failures == 0
+    # a probe that leaks (caller died, no verdict ever recorded) must not
+    # wedge the breaker half-open: after a full reset window with no
+    # answer, a fresh probe is admitted
+    for _ in range(3):
+        b.record_failure()                # open again at t=20.3
+    t["now"] = 30.4
+    assert b.allow()                      # probe admitted, never resolved
+    assert not b.allow()
+    t["now"] = 40.5
+    assert b.allow(), "stale unresolved probe must be superseded"
+    # and an explicitly released probe frees the slot immediately
+    b.release_probe()
+    assert b.allow()
+
+
+@async_test
+async def test_breaker_prevents_hot_loop_and_recovers():
+    """Sustained outage at the REST layer: once the breaker opens, reconcile
+    attempts cost zero HTTP calls until the reset window; after recovery the
+    half-open probe closes it and traffic resumes."""
+    hits = {"n": 0}
+    healthy = {"v": False}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        hits["n"] += 1
+        if healthy["v"]:
+            return httpx.Response(200, json={"name": "p1", "config": {},
+                                             "initialNodeCount": 1})
+        return httpx.Response(503, text="backend down")
+
+    topts = TransportOptions(max_retries=2, backoff_base=0.001,
+                             backoff_cap=0.002, breaker_threshold=5,
+                             breaker_reset=0.2)
+    gke = GKENodePoolsClient(
+        StaticTokenCredential("tok"), "p", "l", "c",
+        transport=topts,
+        http=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    # outage: hammer get() the way a naive controller would
+    for _ in range(30):
+        with pytest.raises(APIError):
+            await gke.get("p1")
+    # 30 calls × 3 attempts = 90 without a breaker; it opens after 5
+    assert hits["n"] <= 6, f"breaker did not bound outage traffic: {hits}"
+    assert gke.breaker.state == BREAKER_OPEN
+    assert gke.breaker.rejected_total > 0
+    # the open-breaker error surfaces as a retryable 503, NOT a 4xx —
+    # controllers requeue with backoff instead of failing terminally
+    try:
+        await gke.get("p1")
+    except APIError as e:
+        assert e.code == 503 and not e.exhausted and not e.not_found
+    # recovery: after the reset window one probe goes through and closes it
+    healthy["v"] = True
+    await asyncio.sleep(0.25)
+    pool = await gke.get("p1")
+    assert pool.name == "p1"
+    assert gke.breaker.state == BREAKER_CLOSED
+    update_runtime_gauges(object())  # no manager: breaker gauges only
+    assert BREAKER_STATE.labels(gke.breaker.name)._value.get() == 0.0
+    assert BREAKERS.get(gke.breaker.name) is gke.breaker
+    await gke.aclose()
+    assert gke.breaker.name not in BREAKERS, "closed client must unregister"
+
+
+@async_test
+async def test_cancelled_probe_releases_breaker_slot():
+    """A reconcile-deadline cancellation mid-probe leaves no HTTP verdict;
+    the transport must free the probe slot instead of blackholing the
+    endpoint until restart."""
+    b = CircuitBreaker("probe-leak", failure_threshold=1, reset_timeout=0.01)
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        raise asyncio.CancelledError()
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    b.record_failure()                    # open
+    await asyncio.sleep(0.02)             # into the half-open window
+    with pytest.raises(asyncio.CancelledError):
+        await request_with_retries(http, "GET", "https://x.test/a",
+                                   opts=TransportOptions(max_retries=0),
+                                   breaker=b)
+    assert b.allow(), "cancelled probe must not wedge the breaker"
+    await http.aclose()
+
+
+@async_test
+async def test_request_with_retries_raises_breaker_open_immediately():
+    async def handler(req):
+        return httpx.Response(500, text="boom")
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    b = CircuitBreaker("rwr", failure_threshold=2, reset_timeout=60.0)
+    opts = TransportOptions(max_retries=5, backoff_base=0.001,
+                            backoff_cap=0.002)
+    with pytest.raises(BreakerOpenError) as ei:
+        await request_with_retries(http, "GET", "https://x.test/a",
+                                   opts=opts, breaker=b)
+    assert ei.value.retry_after > 0
+    await http.aclose()
+
+
+# ---------------------------------------------------------- policy basics
+
+@async_test
+async def test_chaos_policy_is_deterministic_and_windowed():
+    async def collect(policy):
+        out = []
+        for _ in range(40):
+            try:
+                await policy.before_call("nodepools", "get")
+                out.append("ok")
+            except APIError as e:
+                out.append(e.code)
+        return out
+
+    rules = [chaos.FaultRule(match="nodepools.*", rate=0.3,
+                             error=chaos.transient(503), after=5, until=30)]
+    a = await collect(chaos.ChaosPolicy(11, rules=rules))
+    b = await collect(chaos.ChaosPolicy(11, rules=rules))
+    c = await collect(chaos.ChaosPolicy(12, rules=rules))
+    assert a == b, "same seed must inject identically"
+    assert a != c, "different seed should differ"
+    assert all(x == "ok" for x in a[:5]), "window: no faults before `after`"
+    assert all(x == "ok" for x in a[30:]), "window: no faults past `until`"
+    assert any(x == 503 for x in a[5:30])
+
+
+def test_unknown_profile_is_an_error():
+    with pytest.raises(ValueError):
+        chaos.profile("no-such-profile")

@@ -474,3 +474,106 @@ def test_kubeconfig_exec_plugin_auth(tmp_path):
     assert counter.read_text().count("x") == 1
     # past the window it refreshes and picks up the new credential
     assert conn.bearer(1000.0) == "exec-tok-42-2"
+
+
+# --- HTTP status → error-taxonomy mapping (provider level) -----------------
+# The full path a real failure takes: wire status → APIError code →
+# providers/instance.py taxonomy (errors.py) that controllers branch on.
+
+def _provider_over_rest(handler):
+    from gpu_provisioner_tpu.providers.instance import (InstanceProvider,
+                                                        ProviderConfig)
+    from gpu_provisioner_tpu.runtime.client import InMemoryClient
+    gke = gke_client(handler)
+    kube = InMemoryClient()
+    return InstanceProvider(gke, kube, ProviderConfig(
+        node_wait_attempts=2, node_wait_interval=0.01))
+
+
+@async_test
+async def test_provider_maps_http_429_to_insufficient_capacity():
+    from gpu_provisioner_tpu.errors import InsufficientCapacityError
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(429, text="out of v5e capacity")
+
+    with pytest.raises(InsufficientCapacityError):
+        await _provider_over_rest(handler).create(make_nodeclaim("oom"))
+
+
+@async_test
+async def test_provider_maps_operation_resource_exhausted_to_insufficient_capacity():
+    """Async stockout: create POST succeeds but the LRO completes with a
+    google.rpc RESOURCE_EXHAUSTED error — same terminal taxonomy as a
+    synchronous 429."""
+    from gpu_provisioner_tpu.errors import InsufficientCapacityError
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.method == "POST":
+            return httpx.Response(200, json={
+                "name": "op-1", "status": "DONE",
+                "error": {"status": "RESOURCE_EXHAUSTED",
+                          "message": "no capacity"}})
+        raise AssertionError("no polling expected")
+
+    with pytest.raises(InsufficientCapacityError):
+        await _provider_over_rest(handler).create(make_nodeclaim("oom2"))
+
+
+@async_test
+async def test_provider_maps_4xx_to_create_error_with_reason():
+    from gpu_provisioner_tpu.errors import CreateError
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(400, text="bad placementPolicy")
+
+    with pytest.raises(CreateError) as ei:
+        await _provider_over_rest(handler).create(make_nodeclaim("bad"))
+    assert ei.value.reason == "LaunchFailed"
+    assert "placementPolicy" in str(ei.value)
+
+
+@async_test
+async def test_provider_maps_404_to_nodeclaim_not_found():
+    from gpu_provisioner_tpu.errors import NodeClaimNotFoundError
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(404, text="no such pool")
+
+    with pytest.raises(NodeClaimNotFoundError):
+        await _provider_over_rest(handler).delete("ghost")
+
+
+@async_test
+async def test_429_split_kube_retries_gcp_does_not():
+    """The documented 429 split (transport.py): the kube apiserver's 429 is
+    throttling → transport retries it away; the cloud API's 429 is a
+    stockout answer → surfaces on the FIRST response, never retried."""
+    kube_calls = {"n": 0}
+
+    def kube_handler(req: httpx.Request) -> httpx.Response:
+        kube_calls["n"] += 1
+        if kube_calls["n"] == 1:
+            return httpx.Response(429, text="throttled")
+        return httpx.Response(200, json={
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "n1", "resourceVersion": "1"}})
+
+    kube = make_kube_client(kube_handler)
+    node = await kube.get(Node, "n1")
+    assert node.metadata.name == "n1"
+    assert kube_calls["n"] == 2, "kube 429 must be transport-retried"
+
+    gcp_calls = {"n": 0}
+
+    def gcp_handler(req: httpx.Request) -> httpx.Response:
+        gcp_calls["n"] += 1
+        return httpx.Response(429, text="stockout")
+
+    with pytest.raises(APIError) as ei:
+        await gke_client(gcp_handler).get("p1")
+    assert ei.value.exhausted
+    assert gcp_calls["n"] == 1, "cloud 429 must surface without retry"
