@@ -45,7 +45,11 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Headline benchmark JSON line
+bench: ## Provisioning wave benchmark; fails on cloud-call budget regression
+	$(PY) -m bench.bench_provision
+
+.PHONY: bench-headline
+bench-headline: ## Fleet-scale headline benchmark JSON line
 	$(PY) bench.py
 
 ## -------- image -----------------------------------------------------------

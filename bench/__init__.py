@@ -1,0 +1,6 @@
+"""Focused benchmark harnesses (one module per PR's perf claim).
+
+``bench.py`` at the repo root stays the headline fleet-scale number; modules
+here isolate a specific optimization with a before/after harness and write a
+``BENCH_prNN.json`` record that ``make bench`` re-checks for regressions.
+"""

@@ -1,0 +1,325 @@
+"""Provisioning fast-path benchmark (PR 2): list fan-out + instance cache.
+
+Two harnesses, both envtest + FakeCloud, no network:
+
+- **wave**: N NodeClaims through the REAL controller set (create → Registered
+  → Ready), then all deleted and verified gone from the cloud. Reports
+  p50/p95 claim-ready latency, wall clock, total cloud calls by endpoint
+  (from the provider's per-endpoint ``CountingAPI``), and the read-through
+  cache's hit/miss/coalesced counters.
+- **gc_pass**: M pools provisioned, then ONE full ``InstanceGCController``
+  pass timed with a simulated apiserver RTT on every kube call — once with
+  the pre-change list path (``legacy_list``: one kube Node list PER POOL,
+  serially) and once with the fast path (one bulk list + bounded fan-out).
+  The before/after ratio is the PR's headline claim.
+
+Writes ``BENCH_pr02.json`` with ``--write``; by default (and under
+``make bench``) it re-measures and REFUSES to pass if cloud-call counts
+regress beyond the budget recorded in that file.
+
+Usage: python -m bench.bench_provision [--claims 100] [--pools 100]
+                                       [--write] [--fast]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import statistics
+import sys
+import time
+from collections import defaultdict
+from pathlib import Path
+
+BENCH_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr02.json"
+
+# Simulated apiserver round-trip for the GC-pass harness. The in-memory
+# store answers in microseconds; a serial-per-pool list path only shows its
+# real cost when each call carries a wire RTT (1 ms is conservative — GKE
+# apiservers answer list calls in 5-50 ms).
+KUBE_RTT_S = 0.001
+
+
+def _pctl(samples: list[float], q: float) -> float:
+    s = sorted(samples)
+    return s[min(len(s) - 1, math.ceil(q * len(s)) - 1)]
+
+
+class InstrumentedKube:
+    """Counting + fixed-latency wrapper over the kube ``Client`` seam.
+
+    ``calls`` keys are ``"<verb>:<Kind>"`` so the list-path accounting can
+    distinguish Node lists (the per-pool amplification this PR removes)
+    from NodeClaim lists.
+    """
+
+    def __init__(self, inner, latency: float = 0.0):
+        self.inner = inner
+        self.latency = latency
+        self.calls: dict[str, int] = defaultdict(int)
+        self.store = getattr(inner, "store", None)
+
+    async def _hit(self, verb: str, cls: type) -> None:
+        self.calls[f"{verb}:{getattr(cls, '__name__', cls)}"] += 1
+        if self.latency > 0:
+            await asyncio.sleep(self.latency)
+
+    def lists(self, kind: str | None = None) -> int:
+        return sum(n for k, n in self.calls.items()
+                   if k.startswith("list:") and (kind is None or
+                                                 k == f"list:{kind}"))
+
+    async def get(self, cls, name, namespace=""):
+        await self._hit("get", cls)
+        return await self.inner.get(cls, name, namespace)
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        await self._hit("list", cls)
+        return await self.inner.list(cls, labels=labels, namespace=namespace,
+                                     index=index)
+
+    async def create(self, obj):
+        await self._hit("create", type(obj))
+        return await self.inner.create(obj)
+
+    async def update(self, obj):
+        await self._hit("update", type(obj))
+        return await self.inner.update(obj)
+
+    async def update_status(self, obj):
+        await self._hit("update_status", type(obj))
+        return await self.inner.update_status(obj)
+
+    async def delete(self, cls, name, namespace=""):
+        await self._hit("delete", cls)
+        return await self.inner.delete(cls, name, namespace)
+
+    async def evict(self, name, namespace="", uid=""):
+        return await self.inner.evict(name, namespace, uid=uid)
+
+    def watch(self, cls):
+        return self.inner.watch(cls)
+
+
+# ------------------------------------------------------------------ gc pass
+
+async def bench_gc_pass(n_pools: int, legacy: bool,
+                        kube_rtt: float = KUBE_RTT_S) -> dict:
+    """Provision ``n_pools`` slices, then time ONE InstanceGCController pass
+    (cloud list + claim diff + orphan-node scan) with ``kube_rtt`` on every
+    kube call. Returns wall clock + call counts for the pass only."""
+    from gpu_provisioner_tpu.cloudprovider import TPUCloudProvider
+    from gpu_provisioner_tpu.controllers.gc import GCOptions, InstanceGCController
+    from gpu_provisioner_tpu.fake import FakeCloud, make_nodeclaim
+    from gpu_provisioner_tpu.providers.instance import (
+        InstanceProvider, ProviderConfig,
+    )
+    from gpu_provisioner_tpu.apis.core import Node
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+
+    raw = InMemoryClient()
+    raw.store.add_index(Node, "spec.providerID",
+                        lambda o: [o.spec.provider_id])
+    kube = InstrumentedKube(raw, latency=kube_rtt)
+    cloud = FakeCloud(raw, create_latency=0.0, delete_latency=0.0)
+    provider = InstanceProvider(
+        cloud.nodepools, kube,
+        ProviderConfig(node_wait_interval=0.001, node_wait_attempts=50,
+                       legacy_list=legacy),
+        queued=cloud.queuedresources)
+    cp = TPUCloudProvider(provider)
+
+    sem = asyncio.Semaphore(32)
+
+    async def one(i: int):
+        async with sem:
+            await provider.create(make_nodeclaim(f"bp{i:04d}", "tpu-v5e-8"))
+
+    await asyncio.gather(*(one(i) for i in range(n_pools)))
+
+    gc = InstanceGCController(kube, cp, GCOptions(leak_grace=3600.0))
+    kube.calls.clear()
+    provider.nodepools.calls.clear()
+    t0 = time.perf_counter()
+    await gc._collect()
+    wall = time.perf_counter() - t0
+    assert len(cloud.nodepools.pools) == n_pools, "GC pass must reap nothing"
+    return {
+        "pools": n_pools,
+        "wall_s": round(wall, 6),
+        "kube_node_lists": kube.lists("Node"),
+        "kube_lists_total": kube.lists(),
+        "cloud_calls": dict(provider.nodepools.calls),
+        "list_path_calls": kube.lists("Node")
+        + provider.nodepools.calls.get("list", 0),
+    }
+
+
+# --------------------------------------------------------------------- wave
+
+async def bench_wave(n_claims: int, shape: str = "tpu-v5e-8") -> dict:
+    """The 100-claim wave: created → reconciled to Ready by the real
+    controllers → deleted → verified gone from the cloud."""
+    from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+    from gpu_provisioner_tpu.controllers.lifecycle import LifecycleOptions
+    from gpu_provisioner_tpu.controllers.termination import TerminationOptions
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    opts = EnvtestOptions(
+        create_latency=0.05, node_join_delay=0.01, node_ready_delay=0.01,
+        gc_interval=1.0, leak_grace=1.0, node_wait_attempts=600,
+        lifecycle=LifecycleOptions(termination_requeue=0.5,
+                                   registration_requeue=0.5),
+        termination=TerminationOptions(requeue=0.5, instance_requeue=0.5),
+        max_concurrent_reconciles=1024, use_informer=True)
+    async with Env(opts) as env:
+        async def provision(i: int) -> float:
+            t = time.perf_counter()
+            await env.client.create(make_nodeclaim(f"w{i:04d}", shape,
+                                                   workspace=f"ws{i}"))
+            await env.wait_ready(f"w{i:04d}", timeout=120, poll=0.1)
+            return time.perf_counter() - t
+
+        t0 = time.perf_counter()
+        readies = await asyncio.gather(*(provision(i)
+                                         for i in range(n_claims)))
+        ready_wall = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        for i in range(n_claims):
+            await env.client.delete(NodeClaim, f"w{i:04d}")
+        await asyncio.gather(*(env.wait_gone(f"w{i:04d}", timeout=60)
+                               for i in range(n_claims)))
+        delete_wall = time.perf_counter() - t1
+        leaked_pools = len(env.cloud.nodepools.pools)
+        leaked_qrs = len(env.cloud.queuedresources.resources)
+
+        cloud_calls = {f"nodepools.{m}": n
+                       for m, n in env.provider.nodepools.calls.items()}
+        cloud_calls.update({f"queuedresources.{m}": n
+                            for m, n in env.provider.queued.calls.items()})
+        cache = {"pool_cache": dict(env.provider._pool_cache.stats),
+                 "qr_cache": dict(env.provider._qr_cache.stats)}
+    return {
+        "claims": n_claims,
+        "shape": shape,
+        "ready_p50_s": round(statistics.median(readies), 4),
+        "ready_p95_s": round(_pctl(readies, 0.95), 4),
+        "ready_wall_s": round(ready_wall, 3),
+        "delete_wall_s": round(delete_wall, 3),
+        "cloud_calls": cloud_calls,
+        "cloud_calls_total": sum(cloud_calls.values()),
+        "cache": cache,
+        "leaked_pools": leaked_pools,
+        "leaked_queued_resources": leaked_qrs,
+    }
+
+
+# ------------------------------------------------------------------- budget
+
+def check_budget(results: dict, recorded: dict) -> list[str]:
+    """Compare a fresh measurement against the budget block recorded in
+    BENCH_pr02.json. Returns human-readable violations (empty == pass)."""
+    budget = recorded.get("budget", {})
+    out: list[str] = []
+    gc_after = results["gc_pass"]["after"]
+    if budget.get("gc_pass_kube_lists") is not None and \
+            gc_after["kube_lists_total"] > budget["gc_pass_kube_lists"]:
+        out.append(
+            f"gc pass kube lists regressed: {gc_after['kube_lists_total']} > "
+            f"budget {budget['gc_pass_kube_lists']} (per-pool lists back?)")
+    if budget.get("gc_pass_cloud_calls") is not None and \
+            sum(gc_after["cloud_calls"].values()) > budget["gc_pass_cloud_calls"]:
+        out.append(
+            f"gc pass cloud calls regressed: {sum(gc_after['cloud_calls'].values())} "
+            f"> budget {budget['gc_pass_cloud_calls']}")
+    wave = results.get("wave")
+    if wave and budget.get("wave_cloud_calls_per_claim") is not None:
+        per_claim = wave["cloud_calls_total"] / wave["claims"]
+        if per_claim > budget["wave_cloud_calls_per_claim"]:
+            out.append(
+                f"wave cloud calls regressed: {per_claim:.1f}/claim > "
+                f"budget {budget['wave_cloud_calls_per_claim']}/claim")
+    return out
+
+
+async def run(n_claims: int, n_pools: int, with_wave: bool = True) -> dict:
+    before = await bench_gc_pass(n_pools, legacy=True)
+    after = await bench_gc_pass(n_pools, legacy=False)
+    results: dict = {
+        "bench": "provisioning-fast-path",
+        "pr": 2,
+        "kube_rtt_s": KUBE_RTT_S,
+        "gc_pass": {
+            "before": before,
+            "after": after,
+            "wall_speedup": round(before["wall_s"] / max(after["wall_s"],
+                                                         1e-9), 2),
+            "list_path_call_reduction": round(
+                before["list_path_calls"] / max(after["list_path_calls"], 1),
+                2),
+        },
+    }
+    if with_wave:
+        results["wave"] = await bench_wave(n_claims)
+    return results
+
+
+def make_budget(results: dict) -> dict:
+    """Derive the regression budget from a fresh measurement: exact for the
+    deterministic gc-pass counts; 3× headroom for the wave totals, which
+    scale with wall clock (requeue polling during the ready window) — the
+    gate must catch O(n) regressions like a reintroduced hot loop, not a
+    loaded CI machine doubling the wave's duration."""
+    after = results["gc_pass"]["after"]
+    budget = {
+        "gc_pass_kube_lists": after["kube_lists_total"],
+        "gc_pass_cloud_calls": sum(after["cloud_calls"].values()),
+    }
+    wave = results.get("wave")
+    if wave:
+        budget["wave_cloud_calls_per_claim"] = round(
+            3.0 * wave["cloud_calls_total"] / wave["claims"], 1)
+    return budget
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--claims", type=int, default=100)
+    ap.add_argument("--pools", type=int, default=100)
+    ap.add_argument("--fast", action="store_true",
+                    help="small sizes for smoke runs")
+    ap.add_argument("--no-wave", action="store_true")
+    ap.add_argument("--write", action="store_true",
+                    help="rewrite BENCH_pr02.json with fresh numbers+budget")
+    args = ap.parse_args(argv)
+    if args.fast:
+        args.claims, args.pools = 10, 20
+
+    results = asyncio.run(run(args.claims, args.pools,
+                              with_wave=not args.no_wave))
+    print(json.dumps(results, indent=2))
+
+    if args.write:
+        results["budget"] = make_budget(results)
+        BENCH_FILE.write_text(json.dumps(results, indent=2) + "\n")
+        print(f"wrote {BENCH_FILE}", file=sys.stderr)
+        return 0
+
+    if BENCH_FILE.exists():
+        recorded = json.loads(BENCH_FILE.read_text())
+        violations = check_budget(results, recorded)
+        if violations:
+            for v in violations:
+                print(f"BUDGET REGRESSION: {v}", file=sys.stderr)
+            return 1
+        print("cloud-call budget OK "
+              f"(recorded in {BENCH_FILE.name})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,7 @@ counters, workqueue depth/backlog gauges, and circuit-breaker state
 
 from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
+from ..providers.cache import CACHE_STATS, CLOUD_CALLS
 from ..transport import BREAKER_HALF_OPEN, BREAKER_OPEN, BREAKERS
 
 
@@ -86,6 +87,45 @@ BREAKER_REJECTED = _get_or_create(
     "Cumulative calls rejected locally while the breaker was open "
     "(sampled).", ["name"])
 
+# ------------------------------------------------------- provisioning cache
+# Sampled-cumulative gauges (same convention as WORKQUEUE_REQUEUES: the
+# counters live on provider-layer objects prometheus can't own) fed from the
+# providers.cache registries at scrape time.
+
+INSTANCE_CACHE_HITS = _get_or_create(
+    Gauge, "tpu_provisioner_instance_cache_hits",
+    "Read-through instance cache hits (sampled).", ["cache"])
+
+INSTANCE_CACHE_MISSES = _get_or_create(
+    Gauge, "tpu_provisioner_instance_cache_misses",
+    "Read-through instance cache misses (sampled).", ["cache"])
+
+INSTANCE_CACHE_COALESCED = _get_or_create(
+    Gauge, "tpu_provisioner_instance_cache_coalesced",
+    "Reads coalesced onto an in-flight fetch (singleflight, sampled).",
+    ["cache"])
+
+INSTANCE_CACHE_NEGATIVE_HITS = _get_or_create(
+    Gauge, "tpu_provisioner_instance_cache_negative_hits",
+    "Reads served a cached NotFound (sampled).", ["cache"])
+
+INSTANCE_CACHE_INVALIDATIONS = _get_or_create(
+    Gauge, "tpu_provisioner_instance_cache_invalidations",
+    "Explicit cache invalidations on create/delete/state transition "
+    "(sampled).", ["cache"])
+
+CLOUD_API_CALLS = _get_or_create(
+    Gauge, "tpu_provisioner_cloud_api_calls",
+    "Cloud API calls by endpoint (scope.method, sampled).", ["endpoint"])
+
+_CACHE_GAUGES = (
+    ("hits", INSTANCE_CACHE_HITS),
+    ("misses", INSTANCE_CACHE_MISSES),
+    ("coalesced", INSTANCE_CACHE_COALESCED),
+    ("negative_hits", INSTANCE_CACHE_NEGATIVE_HITS),
+    ("invalidations", INSTANCE_CACHE_INVALIDATIONS),
+)
+
 _BREAKER_STATE_VALUE = {BREAKER_OPEN: 2.0, BREAKER_HALF_OPEN: 1.0}
 _exported_breakers: set[str] = set()
 
@@ -101,6 +141,11 @@ def update_runtime_gauges(manager) -> None:
         WORKQUEUE_DELAYED.labels(c.name).set(q.delayed())
         WORKQUEUE_RETRYING.labels(c.name).set(q.retrying())
         WORKQUEUE_REQUEUES.labels(c.name).set(q.requeues_total)
+    for name, stats in CACHE_STATS.items():
+        for stat, gauge in _CACHE_GAUGES:
+            gauge.labels(name).set(stats[stat])
+    for endpoint, calls in CLOUD_CALLS.items():
+        CLOUD_API_CALLS.labels(endpoint).set(calls)
     # Drop series for breakers whose client closed — a stale "open" reading
     # would keep an alert firing for an endpoint nothing gates on anymore.
     for name in _exported_breakers - set(BREAKERS):

@@ -38,6 +38,11 @@ class EnvtestOptions:
     qr_step_latency: float = 0.02
     node_wait_interval: float = 0.02
     node_wait_attempts: int = 30
+    # Read-through instance cache (providers/cache.py), scaled to envtest's
+    # time compression (real default is 1s). 0 disables positive caching
+    # but keeps singleflight coalescing.
+    instance_cache_ttl: float = 0.2
+    instance_cache_negative_ttl: float = 0.1
     gc_interval: float = 0.2
     leak_grace: float = 0.2
     lifecycle: LifecycleOptions = field(default_factory=lambda: LifecycleOptions(
@@ -99,11 +104,20 @@ class Env:
             # layered over the (possibly chaos-wrapped) client: informer
             # re-lists then feel injected apiserver weather too
             kube = CachedListClient(kube, (Node, NodeClaim))
+            # register the providerID index on the cached client too, the
+            # way the real operator wires it (__main__.py) — without it
+            # _pool_name_for silently degrades to the O(nodes) full scan
+            kube.add_index(Node, "spec.providerID",
+                           lambda o: [o.spec.provider_id])
             self.informers = kube
         self.provider = InstanceProvider(
             self.cloud.nodepools, kube,
-            ProviderConfig(node_wait_interval=self.opts.node_wait_interval,
-                           node_wait_attempts=self.opts.node_wait_attempts),
+            ProviderConfig(
+                node_wait_interval=self.opts.node_wait_interval,
+                node_wait_attempts=self.opts.node_wait_attempts,
+                cache_ttl=self.opts.instance_cache_ttl,
+                qr_cache_ttl=0.0,
+                cache_negative_ttl=self.opts.instance_cache_negative_ttl),
             queued=self.cloud.queuedresources)
         self.cloudprovider = MetricsDecorator(TPUCloudProvider(
             self.provider, repair_toleration=self.opts.repair_toleration))

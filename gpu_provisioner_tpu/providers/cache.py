@@ -1,0 +1,209 @@
+"""Read-through instance cache: TTL + singleflight + negative caching (L2).
+
+The provisioning hot loop is dominated by cloud round-trips: every lifecycle
+reconcile and GC pass re-drives ``nodepools.get``/``queued.get`` for claims
+whose cloud state changes on the order of minutes. ``ReadThroughCache`` sits
+in front of those point lookups:
+
+- **TTL**: a fetched entry serves reads for ``ttl`` seconds. The TTL is
+  additionally bounded by a hard ``max_age`` guard (the analog of GC's
+  ``_cache_too_stale``): even a misconfigured ttl can never serve an entry
+  older than ``max_age``.
+- **Singleflight**: concurrent readers of the same key while a fetch is in
+  flight await the one fetch instead of issuing their own (the reconcile
+  storm for a hot claim costs ONE cloud GET per TTL window, not one per
+  worker). Waiters are shielded — a cancelled reconcile never kills the
+  fetch other waiters share.
+- **Negative caching**: a NotFound answer is cached for ``negative_ttl`` so
+  retry loops probing a dead resource don't hammer the API. Any other error
+  is never cached.
+- **Explicit invalidation**: mutations (create/delete/state transition)
+  call ``invalidate(key)``, which both drops the entry AND detaches any
+  in-flight fetch so a read racing the mutation cannot re-populate the
+  cache with pre-mutation state (the same lesson as the provider's pool
+  snapshot: invalidate-after-poll-under-the-lock).
+
+Counters are kept per instance and aggregated into module-level registries
+(``CACHE_STATS``, ``CLOUD_CALLS``) that ``controllers/metrics.py`` samples
+at scrape time — mirroring how transport.py's ``BREAKERS`` registry feeds
+the breaker gauges without this layer importing prometheus.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from typing import Awaitable, Callable, Optional
+
+# ---------------------------------------------------------------- registries
+
+# cache name -> {"hits" | "misses" | "coalesced" | "negative_hits" |
+#                "invalidations": count}, aggregated across instances so
+# /metrics survives provider re-construction (tests, restarts).
+CACHE_STATS: dict[str, dict[str, int]] = {}
+
+# "scope.method" -> cumulative cloud API calls, aggregated across seams.
+CLOUD_CALLS: dict[str, int] = defaultdict(int)
+
+_STAT_KEYS = ("hits", "misses", "coalesced", "negative_hits", "invalidations")
+
+
+def _default_negative(exc: Exception) -> bool:
+    """A cloud 404 in the APIError taxonomy (duck-typed: this module must
+    not import providers.gcp — controllers.metrics imports us)."""
+    return bool(getattr(exc, "not_found", False))
+
+
+class ReadThroughCache:
+    """TTL + singleflight + negative cache in front of an async point fetch.
+
+    ``fetch(key)`` is the cold path (e.g. ``nodepools.get``). ``ttl == 0``
+    disables positive caching but keeps singleflight coalescing — the right
+    mode for externally-advancing state machines (queued resources) where
+    the win is collapsing a concurrent reconcile burst, not serving stale
+    ladder states.
+    """
+
+    # Sweep trigger: a long-lived operator churning through claim names
+    # accumulates one-shot (mostly negative) entries for keys never probed
+    # again; past this size every store sweeps the expired ones. Live,
+    # in-window entries are naturally bounded by the fleet size.
+    MAX_ENTRIES = 4096
+
+    def __init__(self, name: str, fetch: Callable[[str], Awaitable],
+                 ttl: float = 1.0, negative_ttl: float = 0.5,
+                 max_age: float = 30.0,
+                 negative: Callable[[Exception], bool] = _default_negative):
+        self.name = name
+        self.fetch = fetch
+        self.ttl = ttl
+        self.negative_ttl = negative_ttl
+        self.max_age = max_age
+        self._negative = negative
+        # key -> (stamp, value, cached_error)  (error XOR value populated)
+        self._entries: dict[str, tuple[float, object, Optional[Exception]]] = {}
+        self._inflight: dict[str, asyncio.Task] = {}
+        self.stats: dict[str, int] = {k: 0 for k in _STAT_KEYS}
+        self._agg = CACHE_STATS.setdefault(name, {k: 0 for k in _STAT_KEYS})
+
+    # ------------------------------------------------------------- internals
+    def _count(self, stat: str) -> None:
+        self.stats[stat] += 1
+        self._agg[stat] += 1
+
+    @staticmethod
+    def _now() -> float:
+        return asyncio.get_event_loop().time()
+
+    # ------------------------------------------------------------------ read
+    async def get(self, key: str):
+        ent = self._entries.get(key)
+        if ent is not None:
+            stamp, value, err = ent
+            age = self._now() - stamp
+            window = self.negative_ttl if err is not None else self.ttl
+            if age < min(window, self.max_age):
+                if err is not None:
+                    self._count("negative_hits")
+                    raise err
+                self._count("hits")
+                return value
+            self._entries.pop(key, None)  # expired
+
+        task = self._inflight.get(key)
+        if task is not None:
+            self._count("coalesced")
+        else:
+            self._count("misses")
+            task = asyncio.ensure_future(self._do_fetch(key))
+            # assigned before the task first runs (single-threaded loop), so
+            # _do_fetch's identity check below always sees its own entry
+            self._inflight[key] = task
+        # shield: one waiter's cancellation must not kill the shared fetch
+        value, err = await asyncio.shield(task)
+        if err is not None:
+            raise err
+        return value
+
+    async def _do_fetch(self, key: str):
+        """Runs the cold fetch once; returns ``(value, error)`` instead of
+        raising so no waiter-set cancellation can leave an unretrieved task
+        exception. Populates the cache only if this fetch is still the
+        registered in-flight one — ``invalidate`` detaches it."""
+        try:
+            value, err = await self.fetch(key), None
+        except Exception as e:  # noqa: BLE001 — classified below
+            value, err = None, e
+        if self._inflight.get(key) is asyncio.current_task():
+            del self._inflight[key]
+            if err is None:
+                if self.ttl > 0:
+                    self._store(key, value, None)
+            elif self._negative(err) and self.negative_ttl > 0:
+                self._store(key, None, err)
+        return value, err
+
+    def _store(self, key: str, value, err: Optional[Exception]) -> None:
+        if len(self._entries) >= self.MAX_ENTRIES:
+            self._sweep()
+        self._entries[key] = (self._now(), value, err)
+
+    def _sweep(self) -> None:
+        """Drop every expired entry — keys that will never be re-read
+        (departed claims' negative entries) must not accumulate forever."""
+        now = self._now()
+        for k, (stamp, _, err) in list(self._entries.items()):
+            window = self.negative_ttl if err is not None else self.ttl
+            if now - stamp >= min(window, self.max_age):
+                del self._entries[k]
+
+    # ------------------------------------------------------------ mutations
+    def invalidate(self, key: str) -> None:
+        """Drop the entry and detach any in-flight fetch for ``key``.
+
+        Detaching (not cancelling) means racing waiters still get their
+        answer — they started reading before the mutation, stale-read
+        semantics no worse than an uncached read issued at the same moment —
+        but the result is NOT stored, so no read started before a delete can
+        resurrect the deleted resource in the cache."""
+        self._count("invalidations")
+        self._entries.pop(key, None)
+        self._inflight.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class CountingAPI:
+    """Transparent per-endpoint call counter around a cloud API seam
+    (``NodePoolsAPI`` / ``QueuedResourcesAPI``).
+
+    Every awaited method increments both an instance counter (bench/test
+    isolation) and the module-level ``CLOUD_CALLS`` aggregate that
+    ``controllers/metrics.py`` exports. Non-coroutine attributes (fake
+    helpers like ``fail``/``pools``) pass through untouched.
+    """
+
+    def __init__(self, inner, scope: str):
+        self._inner = inner
+        self.scope = scope
+        self.calls: dict[str, int] = defaultdict(int)
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if not asyncio.iscoroutinefunction(attr):
+            return attr
+        scope = self.scope
+
+        async def counted(*args, **kwargs):
+            # resolve at call time so test monkeypatches on the inner fake
+            # (e.g. counted list() spies) keep working through the wrapper
+            self.calls[name] += 1
+            CLOUD_CALLS[f"{scope}.{name}"] += 1
+            return await getattr(self._inner, name)(*args, **kwargs)
+
+        counted.__name__ = name
+        return counted
+
+    def total(self) -> int:
+        return sum(self.calls.values())

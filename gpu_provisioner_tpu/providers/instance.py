@@ -23,6 +23,7 @@ import asyncio
 import logging
 import random
 import re
+from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -36,6 +37,7 @@ from ..errors import (
 )
 from ..runtime.client import Client
 from ..scheduling import Requirements
+from .cache import CountingAPI, ReadThroughCache
 from .gcp import (
     APIError, NodePool, NodePoolConfig, NodePoolsAPI, PlacementPolicy,
     QueuedResource, QueuedResourcesAPI, poll_until_done,
@@ -134,6 +136,23 @@ class ProviderConfig:
     node_wait_attempts: int = 30
     node_wait_interval: float = 1.0
     node_wait_jitter: float = 0.1
+    # Read-through cache in front of nodepools.get: a pool's status changes
+    # on the order of minutes, so a ~1s TTL absorbs the reconcile-storm
+    # re-reads without a visible staleness window. max_age is the hard guard
+    # (GC's _cache_too_stale analog) — never serveable past it whatever ttl
+    # says. negative_ttl bounds NotFound probe loops.
+    cache_ttl: float = 1.0
+    cache_negative_ttl: float = 0.5
+    cache_max_age: float = 30.0
+    # Queued-resource lookups coalesce concurrent GETs but default to NO
+    # positive TTL: the QR ladder advances server-side and a cached WAITING
+    # would stretch every requeue by the TTL for zero saved calls (the
+    # requeue cadence already spaces them out).
+    qr_cache_ttl: float = 0.0
+    # Pre-fast-path list() (one kube Node list PER POOL, serially) — kept
+    # only as the benchmark baseline (bench/bench_provision.py measures the
+    # fast path against it). Never enable in production.
+    legacy_list: bool = False
 
 
 class InstanceProvider:
@@ -146,10 +165,27 @@ class InstanceProvider:
     def __init__(self, nodepools: NodePoolsAPI, kube: Client,
                  config: Optional[ProviderConfig] = None,
                  queued: Optional[QueuedResourcesAPI] = None):
-        self.nodepools = nodepools
-        self.queued = queued
+        # every cloud seam is wrapped in a per-endpoint call counter so the
+        # /metrics surface (and the bench harness) can see exactly what the
+        # control loops cost the cloud APIs
+        self.nodepools = CountingAPI(nodepools, "nodepools")
+        self.queued = (CountingAPI(queued, "queuedresources")
+                       if queued is not None else None)
         self.kube = kube
         self.cfg = config or ProviderConfig()
+        # Read-through caches (providers/cache.py): point lookups on the
+        # cloud seams, singleflight-coalesced, explicitly invalidated by
+        # create/delete/state transitions below.
+        self._pool_cache = ReadThroughCache(
+            "nodepools.get", self.nodepools.get,
+            ttl=self.cfg.cache_ttl, negative_ttl=self.cfg.cache_negative_ttl,
+            max_age=self.cfg.cache_max_age)
+        self._qr_cache = ReadThroughCache(
+            "queuedresources.get",
+            self.queued.get if self.queued is not None else _no_fetch,
+            ttl=self.cfg.qr_cache_ttl,
+            negative_ttl=self.cfg.cache_negative_ttl,
+            max_age=self.cfg.cache_max_age)
         # (timestamp, pools, {group: claim-name fingerprint at list time})
         self._pool_snapshot: Optional[
             tuple[float, list[NodePool], dict[str, frozenset]]] = None
@@ -237,7 +273,10 @@ class InstanceProvider:
                 raise CreateError(f"creating nodepool {name}: {e}") from e
 
         nodes = await self._wait_for_nodes(name, shape.hosts)
-        created = await self.nodepools.get(name)
+        # state transition just happened (create LRO completed) — drop any
+        # entry cached during the wait so the final read sees RUNNING
+        self._pool_cache.invalidate(name)
+        created = await self._get_pool(name)
         return self._to_instance(created, shape=shape, nodes=nodes)
 
     def _queued_mode(self, nc: NodeClaim, reqs: Requirements) -> bool:
@@ -258,13 +297,18 @@ class InstanceProvider:
         """
         name = nc.metadata.name
         try:
-            qr = await self.queued.get(name)
+            # singleflight-coalesced: a burst of reconciles for the same
+            # claim shares one in-flight cloud GET (qr_cache_ttl defaults to
+            # 0 — coalescing without serving stale ladder states)
+            qr = await self._qr_cache.get(name)
         except APIError as e:
             if not e.not_found:
                 raise CreateError(f"getting queued resource {name}: {e}") from e
+            self._qr_cache.invalidate(name)  # drop the negative entry …
             qr = await self.queued.create(QueuedResource(
                 name=name, accelerator_type=shape.slice_name, node_pool=name,
                 spot=capacity_type == wk.CAPACITY_TYPE_SPOT))
+            self._qr_cache.invalidate(name)  # … and anything raced in since
         if qr.state in (QR_SUSPENDED, QR_FAILED):
             raise InsufficientCapacityError(
                 f"queued resource {name} {qr.state}: {qr.state_message}")
@@ -407,10 +451,14 @@ class InstanceProvider:
         interval = self.cfg.node_wait_interval
         ready: list[Node] = []
         while True:
+            # per-poll reads go through self.kube: wired behind the informer
+            # (CachedListClient) this is watch-cache maintenance, not a fresh
+            # apiserver LIST per iteration — hundreds of concurrent waits
+            # poll for free
             nodes = await self._nodes_of_pool(pool)
-            ready = [n for n in nodes if n.spec.provider_id]
+            ready = ready_workers(nodes)
             if len(ready) >= hosts:
-                return sorted(ready, key=worker_index)
+                return ready
             if asyncio.get_event_loop().time() >= deadline:
                 break
             await asyncio.sleep(interval
@@ -424,12 +472,17 @@ class InstanceProvider:
         return await self.kube.list(Node, labels={wk.GKE_NODEPOOL_LABEL: pool})
 
     # ---------------------------------------------------------- get/list
+    async def _get_pool(self, name: str) -> NodePool:
+        """Read-through, singleflight-coalesced ``nodepools.get`` — the hot
+        point lookup every lifecycle/termination reconcile re-drives."""
+        return await self._pool_cache.get(name)
+
     async def get(self, pid: str) -> Instance:
         pool_name = await self._pool_name_for(pid)
         if pool_name is None:
             raise NodeClaimNotFoundError(f"no node pool for providerID {pid}")
         try:
-            pool = await self.nodepools.get(pool_name)
+            pool = await self._get_pool(pool_name)
         except APIError as e:
             if e.not_found:
                 raise NodeClaimNotFoundError(f"nodepool {pool_name} not found") from e
@@ -437,10 +490,14 @@ class InstanceProvider:
         return await self._from_pool(pool)
 
     async def _pool_name_for(self, pid: str) -> Optional[str]:
-        nodes = await self.kube.list(Node, index=("spec.providerID", pid)) \
-            if has_index(self.kube) else []
-        if not nodes:
-            nodes = [n for n in await self.kube.list(Node) if n.spec.provider_id == pid]
+        if has_index(self.kube):
+            # the index applies the same predicate the scan would — an empty
+            # answer is authoritative, never fall through to the O(nodes)
+            # scan for it (every terminated claim's node is a permanent miss)
+            nodes = await self.kube.list(Node, index=("spec.providerID", pid))
+        else:
+            nodes = [n for n in await self.kube.list(Node)
+                     if n.spec.provider_id == pid]
         if nodes:
             pool = nodes[0].metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
             if pool:
@@ -449,14 +506,34 @@ class InstanceProvider:
 
     async def list(self) -> list[Instance]:
         """All kaito-owned, nodeclaim-created instances (fromAPListToInstances
-        :289-319 + ownership gates :387-413)."""
+        :289-319 + ownership gates :387-413).
+
+        Fast path: ONE bulk kube Node list grouped by the GKE node-pool
+        label. With the per-pool I/O collapsed into the bulk list, the
+        remaining per-pool conversion is pure CPU (catalog lookup + field
+        mapping) — no fan-out machinery, just a comprehension. The
+        pre-change shape — one kube list per pool, serially — cost a
+        100-slice cluster ~100 sequential apiserver round-trips per GC
+        tick; it survives only as the benchmark baseline
+        (``cfg.legacy_list``)."""
         pools = await self.nodepools.list()
-        out = []
-        for p in pools:
-            if not pool_owned_by_kaito(p) or not pool_created_from_nodeclaim(p):
-                continue
-            out.append(await self._from_pool(p))
-        return out
+        owned = [p for p in pools
+                 if pool_owned_by_kaito(p) and pool_created_from_nodeclaim(p)]
+        if self.cfg.legacy_list:
+            return [await self._from_pool(p) for p in owned]
+
+        # narrowed to kaito-owned nodes (the pool's labels propagate to its
+        # nodes): in a shared cluster the bulk list must not drag thousands
+        # of foreign Node objects out of the informer cache per GC tick
+        nodes_by_pool = _group_by_pool(await self.kube.list(
+            Node, labels={wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME}))
+        return [
+            self._to_instance(
+                p,
+                shape=cat.lookup(p.config.labels.get(wk.INSTANCE_TYPE_LABEL, "")),
+                nodes=nodes_by_pool.get(p.name, []))
+            for p in owned
+        ]
 
     async def _from_pool(self, pool: NodePool) -> Instance:
         nodes = await self._nodes_of_pool(pool.name)
@@ -465,7 +542,7 @@ class InstanceProvider:
 
     def _to_instance(self, pool: NodePool, shape: Optional[cat.SliceShape],
                      nodes: list[Node]) -> Instance:
-        nodes = sorted([n for n in nodes if n.spec.provider_id], key=worker_index)
+        nodes = ready_workers(nodes)
         pids = [n.spec.provider_id for n in nodes]
         return Instance(
             name=pool.name,
@@ -498,18 +575,34 @@ class InstanceProvider:
             except APIError as e:
                 if not e.not_found:
                     raise
+            finally:
+                # unconditionally: success AND failure paths must both drop
+                # any cached QR view — a cached entry must never make a
+                # retried delete() skip the queued-resource cleanup
+                self._qr_cache.invalidate(name)
+        # LIVE read, deliberately around the cache: delete decisions (skip
+        # if already Deleting) must never ride a stale cached status.
         try:
             pool = await self.nodepools.get(name)
         except APIError as e:
             if e.not_found:
+                self._pool_cache.invalidate(name)
                 raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
             raise
         if pool.status == NP_STOPPING:
+            # an out-of-band delete is in flight: drop any cached pre-delete
+            # view so get() reports Deleting, not a stale RUNNING (every
+            # other observed transition invalidates — keep the symmetry)
+            self._pool_cache.invalidate(name)
             log.info("nodepool %s already deleting, skipping", name)
             return
         try:
             op = await self.nodepools.begin_delete(name)
+            self._pool_cache.invalidate(name)  # state transition: Deleting
             await poll_until_done(op)
+            # again after the poll: a read begun mid-delete may have cached
+            # the dying pool between the first invalidation and completion
+            self._pool_cache.invalidate(name)
             # belt-and-braces: the claim-set fingerprint in _pools_snapshot
             # is the primary freshness guard (a departed member changes the
             # live claim list); dropping the snapshot on OUR OWN pool
@@ -521,11 +614,34 @@ class InstanceProvider:
                 self._pool_snapshot = None
         except APIError as e:
             if e.not_found:
+                self._pool_cache.invalidate(name)
                 raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
             raise
 
 
 # --------------------------------------------------------------- helpers
+
+async def _no_fetch(name: str):
+    raise APIError(f"queued resources API not configured ({name})", code=404)
+
+
+def _group_by_pool(nodes: list[Node]) -> dict[str, list[Node]]:
+    """Bulk Node list → per-pool buckets keyed by the GKE node-pool label —
+    the one pass that replaces a kube list per pool in the fast path."""
+    by_pool: dict[str, list[Node]] = defaultdict(list)
+    for n in nodes:
+        pool = n.metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
+        if pool:
+            by_pool[pool].append(n)
+    return by_pool
+
+
+def ready_workers(nodes: list[Node]) -> list[Node]:
+    """ProviderID'd nodes in worker-index order — the single normalization
+    both the node wait and instance conversion need (hoisted: each used to
+    filter+sort independently)."""
+    return sorted((n for n in nodes if n.spec.provider_id), key=worker_index)
+
 
 def ts_label(t) -> str:
     """RFC3339 isn't label-safe; use the reference's datetime label trick
@@ -577,5 +693,19 @@ def worker_index(node: Node) -> int:
 
 
 def has_index(kube: Client) -> bool:
-    store = getattr(kube, "store", None)
-    return store is not None and (Node, "spec.providerID") in getattr(store, "_indexes", {})
+    """True if ``kube.list(Node, index=("spec.providerID", …))`` takes an
+    index path. Walks wrapper layers (CachedListClient._indexes, ChaosClient
+    .inner, raw client .store) — the index used to go undetected behind the
+    informer/chaos wrappers, silently degrading ``_pool_name_for`` to the
+    O(nodes) full-scan fallback."""
+    seen: set[int] = set()
+    while kube is not None and id(kube) not in seen:
+        seen.add(id(kube))
+        if (Node, "spec.providerID") in getattr(kube, "_indexes", {}):
+            return True
+        store = getattr(kube, "store", None)
+        if store is not None and \
+                (Node, "spec.providerID") in getattr(store, "_indexes", {}):
+            return True
+        kube = getattr(kube, "inner", None)
+    return False

@@ -60,7 +60,19 @@ class Informer:
         # O(cache) — a linear items() scan under hundreds of concurrent
         # node-waits melted the event loop at 512+ claims
         self._by_label: dict[tuple[str, str], set] = {}
+        # field inverted indexes (spec.providerID etc.), same O(result)
+        # argument: _pool_name_for runs once per lifecycle/termination
+        # reconcile — a key_fn scan over the whole Node cache per call would
+        # quietly re-create the cost the index exists to remove
+        self._index_fns: dict[str, object] = {}
+        self._by_index: dict[tuple[str, str], set] = {}
         self._task: Optional[asyncio.Task] = None
+
+    def add_index(self, name: str, key_fn) -> None:
+        self._index_fns[name] = key_fn
+        for key, obj in self._cache.items():  # backfill a live cache
+            for v in key_fn(obj) or []:
+                self._by_index.setdefault((name, v), set()).add(key)
 
     @staticmethod
     def _key(obj: Object) -> tuple[str, str]:
@@ -74,6 +86,9 @@ class Informer:
         self._cache[key] = obj
         for lk_lv in obj.metadata.labels.items():
             self._by_label.setdefault(lk_lv, set()).add(key)
+        for name, fn in self._index_fns.items():
+            for v in fn(obj) or []:
+                self._by_index.setdefault((name, v), set()).add(key)
 
     def _remove(self, obj: Object) -> None:
         key = self._key(obj)
@@ -84,6 +99,9 @@ class Informer:
     def _unindex(self, key, obj: Object) -> None:
         for lk_lv in obj.metadata.labels.items():
             self._by_label.get(lk_lv, set()).discard(key)
+        for name, fn in self._index_fns.items():
+            for v in fn(obj) or []:
+                self._by_index.get((name, v), set()).discard(key)
 
     async def start(self) -> None:
         if self._task is not None:
@@ -123,6 +141,7 @@ class Informer:
         objs = await self.client.list(self.cls)
         self._cache = {}
         self._by_label = {}
+        self._by_index = {}
         for o in objs:
             self._upsert(o)
         self.last_sync = asyncio.get_event_loop().time()
@@ -174,12 +193,20 @@ class Informer:
 
     def items(self, labels: Optional[dict[str, str]] = None,
               namespace: Optional[str] = None,
-              index_fn=None, index_value=None) -> list[Object]:
+              index_fn=None, index_value=None,
+              index_name=None) -> list[Object]:
         """Cache snapshot with the same filter semantics as Client.list.
         Deep copies — callers mutate their listed objects freely (the
         controllers do) and must never write through into the cache.
-        Label queries narrow through the inverted index first (O(result))."""
-        if labels:
+        Label and registered-field-index queries narrow through the
+        inverted maps first (O(result)); an unregistered index_fn falls
+        back to the scan."""
+        if index_name is not None and index_name in self._index_fns:
+            keys = self._by_index.get((index_name, index_value), set())
+            candidates = [(k, self._cache[k]) for k in list(keys)
+                          if k in self._cache]
+            index_fn = None  # membership guaranteed by index maintenance
+        elif labels:
             lk, lv = next(iter(labels.items()))
             keys = self._by_label.get((lk, lv), set())
             candidates = [(k, self._cache[k]) for k in list(keys)
@@ -224,6 +251,9 @@ class CachedListClient:
 
     def add_index(self, cls: type, name: str, key_fn) -> None:
         self._indexes[(cls, name)] = key_fn
+        inf = self._informers.get(cls)
+        if inf is not None:
+            inf.add_index(name, key_fn)  # O(result) map, not a key_fn scan
         if hasattr(self.inner, "add_index"):
             self.inner.add_index(cls, name, key_fn)
 
@@ -246,7 +276,8 @@ class CachedListClient:
             key_fn = self._indexes.get((cls, name))
             if key_fn is None:
                 return await self.inner.list(cls, labels, namespace, index)
-            return inf.items(labels, namespace, key_fn, value)
+            return inf.items(labels, namespace, key_fn, value,
+                             index_name=name)
         return inf.items(labels, namespace)
 
     # --- pass-throughs ----------------------------------------------------

@@ -1,0 +1,53 @@
+"""Bench-harness smoke tests (marked ``bench`` + ``slow``: excluded from the
+tier-1 gate, run via ``make bench`` / ``pytest -m bench``)."""
+
+import pytest
+
+from bench.bench_provision import (
+    bench_gc_pass, check_budget, make_budget,
+)
+
+from .conftest import async_test
+
+pytestmark = [pytest.mark.bench, pytest.mark.slow]
+
+
+@async_test
+async def test_gc_pass_fast_path_beats_legacy():
+    """The PR's headline claim at smoke scale: the fast list path does ONE
+    bulk Node list per GC pass (legacy did one per pool) and wins wall
+    clock under a modeled apiserver RTT."""
+    before = await bench_gc_pass(20, legacy=True)
+    after = await bench_gc_pass(20, legacy=False)
+    # fast path: 1 bulk Node list (cp.list) + 1 orphan-node list + 1 claim list
+    assert after["kube_lists_total"] == 3, after
+    assert after["kube_node_lists"] == 2, after
+    assert before["kube_node_lists"] >= 20, before
+    assert before["list_path_calls"] / after["list_path_calls"] >= 5
+    assert before["wall_s"] > after["wall_s"]
+
+
+@async_test
+async def test_gc_pass_reaps_nothing_during_measurement():
+    out = await bench_gc_pass(5, legacy=False)
+    assert out["pools"] == 5  # asserted inside the harness too
+
+
+def test_budget_check_flags_regression_and_passes_clean():
+    recorded = {"budget": {"gc_pass_kube_lists": 3,
+                           "gc_pass_cloud_calls": 2,
+                           "wave_cloud_calls_per_claim": 10.0}}
+    bad = {"gc_pass": {"after": {"kube_lists_total": 23,
+                                 "cloud_calls": {"list": 1}}},
+           "wave": {"claims": 10, "cloud_calls_total": 500}}
+    violations = check_budget(bad, recorded)
+    assert any("kube lists" in v for v in violations)
+    assert any("wave cloud calls" in v for v in violations)
+
+    good = {"gc_pass": {"after": {"kube_lists_total": 3,
+                                  "cloud_calls": {"list": 1}}},
+            "wave": {"claims": 10, "cloud_calls_total": 80}}
+    assert check_budget(good, recorded) == []
+    derived = make_budget(good)
+    assert derived["gc_pass_kube_lists"] == 3
+    assert derived["wave_cloud_calls_per_claim"] == 24.0  # 3× headroom

@@ -183,6 +183,30 @@ async def test_soak_stuck_queued_resource_does_not_leak_qr():
 
 
 @async_test
+async def test_soak_stuck_queue_cached_provider_no_qr_leak():
+    """PR 2 composition check: the read-through instance cache + informer
+    layering must preserve PR 1's stuck-queue invariant — delete() still
+    performs queued-resource cleanup FIRST, and no cached (or negative)
+    entry lets a retried delete skip it. Zero leaked queued resources."""
+    from gpu_provisioner_tpu.providers.instance import has_index
+
+    policy = chaos.profile("stuck-queue", seed=SEED)
+    names = [f"cq{i}" for i in range(3)]
+    async with chaos_env(policy, launch_timeout=1.0,
+                         use_informer=True) as env:
+        assert env.provider.cfg.cache_ttl > 0, "cache must actually be on"
+        assert has_index(env.provider.kube), "index wiring must survive"
+        for n in names:
+            await env.client.create(make_nodeclaim(
+                n, annotations={PROVISIONING_MODE_ANNOTATION: "queued"}))
+        ready, gone = await converge(env, names, timeout=20.0)
+        assert gone == set(names), "stuck queued claims must be reaped"
+        await assert_no_leaks_and_drained(env, set())
+        assert env.provider.queued.calls["delete"] >= len(names), \
+            "queued cleanup must have run through the counted seam"
+
+
+@async_test
 async def test_soak_operation_result_error_no_duplicate_pools():
     """LRO done()→result() raises and leaves an ERROR pool carcass: retries
     must replace the carcass in place — never duplicate, never wedge."""

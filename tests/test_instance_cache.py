@@ -1,0 +1,312 @@
+"""Read-through instance cache (providers/cache.py): TTL, singleflight,
+negative caching, max-age guard, invalidation-vs-inflight races — and the
+provider-level correctness the ISSUE pins: a cached entry must never hide a
+deletion from get()/list(), and the cache/cloud-call counters must surface
+at /metrics."""
+
+import asyncio
+
+import pytest
+from prometheus_client import REGISTRY, generate_latest
+
+from gpu_provisioner_tpu.cloudprovider.errors import NodeClaimNotFoundError
+from gpu_provisioner_tpu.controllers.metrics import update_runtime_gauges
+from gpu_provisioner_tpu.fake import FakeCloud, make_nodeclaim
+from gpu_provisioner_tpu.providers.cache import (
+    CACHE_STATS, CLOUD_CALLS, CountingAPI, ReadThroughCache,
+)
+from gpu_provisioner_tpu.providers.gcp import APIError
+from gpu_provisioner_tpu.providers.instance import (
+    InstanceProvider, ProviderConfig,
+)
+from gpu_provisioner_tpu.runtime import InMemoryClient
+
+from .conftest import async_test
+
+
+class Backend:
+    """Scriptable fetch target: per-key values, errors, latency, call log."""
+
+    def __init__(self):
+        self.values: dict[str, object] = {}
+        self.latency = 0.0
+        self.calls: list[str] = []
+
+    async def fetch(self, key: str):
+        # snapshot at request time (a real GET answers from the state the
+        # server held when it received the request), then simulate the RTT
+        self.calls.append(key)
+        missing = key not in self.values
+        value = self.values.get(key)
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        if missing:
+            raise APIError(f"{key} not found", code=404)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+
+# --- unit: ReadThroughCache -------------------------------------------------
+
+@async_test
+async def test_cache_hit_miss_and_ttl_expiry():
+    b = Backend()
+    b.values["a"] = 1
+    c = ReadThroughCache("t.hitmiss", b.fetch, ttl=0.05, negative_ttl=0.05)
+    assert await c.get("a") == 1 and c.stats["misses"] == 1
+    assert await c.get("a") == 1 and c.stats["hits"] == 1
+    assert b.calls == ["a"]
+    await asyncio.sleep(0.06)              # past the TTL
+    b.values["a"] = 2
+    assert await c.get("a") == 2 and c.stats["misses"] == 2
+
+
+@async_test
+async def test_singleflight_coalesces_concurrent_readers():
+    b = Backend()
+    b.values["k"] = "v"
+    b.latency = 0.03
+    c = ReadThroughCache("t.sf", b.fetch, ttl=1.0)
+    got = await asyncio.gather(*(c.get("k") for _ in range(8)))
+    assert got == ["v"] * 8
+    assert len(b.calls) == 1, "8 concurrent readers must share one fetch"
+    assert c.stats["misses"] == 1 and c.stats["coalesced"] == 7
+
+
+@async_test
+async def test_singleflight_with_ttl_zero_still_coalesces():
+    """ttl=0 (the queued-resource mode) keeps coalescing but stores nothing:
+    sequential reads each refetch."""
+    b = Backend()
+    b.values["k"] = "v"
+    b.latency = 0.02
+    c = ReadThroughCache("t.sf0", b.fetch, ttl=0.0)
+    await asyncio.gather(*(c.get("k") for _ in range(4)))
+    assert len(b.calls) == 1
+    await c.get("k")
+    assert len(b.calls) == 2, "ttl=0 must not serve a stored entry"
+
+
+@async_test
+async def test_negative_caching_and_error_passthrough():
+    b = Backend()
+    c = ReadThroughCache("t.neg", b.fetch, ttl=1.0, negative_ttl=0.5)
+    with pytest.raises(APIError):
+        await c.get("ghost")
+    with pytest.raises(APIError):
+        await c.get("ghost")               # served from the negative entry
+    assert len(b.calls) == 1 and c.stats["negative_hits"] == 1
+    # non-NotFound errors are never cached
+    b.values["flaky"] = APIError("boom", code=503)
+    with pytest.raises(APIError):
+        await c.get("flaky")
+    b.values["flaky"] = "ok"
+    assert await c.get("flaky") == "ok", "5xx must not stick in the cache"
+
+
+@async_test
+async def test_max_age_guard_bounds_misconfigured_ttl():
+    b = Backend()
+    b.values["a"] = 1
+    c = ReadThroughCache("t.maxage", b.fetch, ttl=3600.0, max_age=0.05)
+    await c.get("a")
+    await asyncio.sleep(0.06)
+    await c.get("a")
+    assert len(b.calls) == 2, "max_age must override a huge ttl"
+
+
+@async_test
+async def test_invalidate_detaches_inflight_fetch():
+    """A read racing a delete must not re-populate the cache with
+    pre-delete state: invalidate() detaches the in-flight fetch, so its
+    result is returned to its waiters but never stored."""
+    b = Backend()
+    b.values["p"] = "pre-delete"
+    b.latency = 0.05
+    c = ReadThroughCache("t.race", b.fetch, ttl=60.0)
+    reader = asyncio.ensure_future(c.get("p"))
+    await asyncio.sleep(0.01)              # fetch in flight
+    c.invalidate("p")                      # the delete lands
+    del b.values["p"]
+    assert await reader == "pre-delete"    # racer gets its answer …
+    with pytest.raises(APIError):          # … but nothing was cached
+        await c.get("p")
+    assert len(b.calls) == 2
+
+
+@async_test
+async def test_waiter_cancellation_does_not_kill_shared_fetch():
+    b = Backend()
+    b.values["k"] = "v"
+    b.latency = 0.05
+    c = ReadThroughCache("t.cancel", b.fetch, ttl=1.0)
+    first = asyncio.ensure_future(c.get("k"))
+    await asyncio.sleep(0.01)
+    second = asyncio.ensure_future(c.get("k"))
+    await asyncio.sleep(0.01)
+    first.cancel()
+    assert await second == "v", "surviving waiter must still get the fetch"
+    assert len(b.calls) == 1
+
+
+# --- unit: CountingAPI ------------------------------------------------------
+
+@async_test
+async def test_counting_api_counts_and_passes_through():
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=0.0)
+    before = CLOUD_CALLS.get("nodepools.list", 0)
+    api = CountingAPI(cloud.nodepools, "nodepools")
+    assert await api.list() == []
+    assert api.calls["list"] == 1 and api.total() == 1
+    assert CLOUD_CALLS["nodepools.list"] == before + 1
+    assert api.pools == {}                       # non-coroutine passthrough
+    api.fail("get", APIError("x", code=404))     # fake helper passthrough
+    with pytest.raises(APIError):
+        await api.get("nope")
+
+
+# --- provider integration ---------------------------------------------------
+
+def provider_setup(**cfg):
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=0.01, delete_latency=0.01)
+    provider = InstanceProvider(
+        cloud.nodepools, kube,
+        ProviderConfig(node_wait_attempts=20, node_wait_interval=0.01, **cfg),
+        queued=cloud.queuedresources)
+    return kube, cloud, provider
+
+
+@async_test
+async def test_provider_get_serves_from_cache_within_ttl():
+    kube, cloud, provider = provider_setup(cache_ttl=60.0)
+    inst = await provider.create(make_nodeclaim("c0", "tpu-v5e-8"))
+    gets = cloud.nodepools.calls["get"]
+    for _ in range(5):
+        got = await provider.get(inst.id)
+        assert got.name == "c0"
+    assert cloud.nodepools.calls["get"] == gets, \
+        "gets within the TTL must not hit the cloud"
+    assert provider._pool_cache.stats["hits"] >= 5
+
+
+@async_test
+async def test_provider_concurrent_gets_coalesce():
+    kube, cloud, provider = provider_setup(cache_ttl=0.0)  # coalesce-only
+    inst = await provider.create(make_nodeclaim("c1", "tpu-v5e-8"))
+    gets = cloud.nodepools.calls["get"]
+    await asyncio.gather(*(provider.get(inst.id) for _ in range(8)))
+    assert cloud.nodepools.calls["get"] - gets <= 2, \
+        "a concurrent reconcile burst must share in-flight cloud GETs"
+
+
+@async_test
+async def test_delete_then_get_and_list_within_ttl_observe_deletion():
+    """The acceptance-criteria invariant: a cached entry must never serve a
+    deleted pool — get() is invalidated by delete(), and list() (the GC
+    feed) never reads through the point cache at all."""
+    kube, cloud, provider = provider_setup(cache_ttl=3600.0)
+    inst = await provider.create(make_nodeclaim("d0", "tpu-v5e-8"))
+    assert (await provider.get(inst.id)).name == "d0"   # hot in cache
+    await provider.delete("d0")
+    with pytest.raises(NodeClaimNotFoundError):
+        await provider.get(inst.id)
+    assert [i.name for i in await provider.list()] == []
+
+
+@async_test
+async def test_negative_cache_bounds_ghost_probes():
+    kube, cloud, provider = provider_setup(cache_ttl=60.0,
+                                           cache_negative_ttl=60.0)
+    pid = "gce://test-project/us-central2-b/gke-kaito-ghost-w0"
+    gets = cloud.nodepools.calls["get"]
+    for _ in range(4):
+        with pytest.raises(NodeClaimNotFoundError):
+            await provider.get(pid)
+    assert cloud.nodepools.calls["get"] == gets + 1, \
+        "repeated ghost probes must be served by the negative entry"
+    assert provider._pool_cache.stats["negative_hits"] >= 3
+
+
+@async_test
+async def test_queued_cleanup_still_runs_with_cached_qr_view():
+    """delete() must perform queued-resource cleanup first even when the QR
+    cache holds a (possibly negative) entry for the claim."""
+    from gpu_provisioner_tpu.providers.instance import (
+        PROVISIONING_MODE_ANNOTATION,
+    )
+    kube, cloud, provider = provider_setup(cache_negative_ttl=60.0)
+    cloud.qr_step_latency = 999  # wedge the ladder: claim never completes
+    nc = make_nodeclaim("q0", annotations={
+        PROVISIONING_MODE_ANNOTATION: "queued"})
+    with pytest.raises(Exception):
+        await provider.create(nc)            # QR created, pool never exists
+    assert "q0" in cloud.queuedresources.resources
+    with pytest.raises(NodeClaimNotFoundError):
+        await provider.delete("q0")          # no pool → NotFound, but…
+    assert "q0" not in cloud.queuedresources.resources, \
+        "queued cleanup must have run before the pool lookup"
+    # and a retried delete (cache now negative for q0) must not resurrect it
+    with pytest.raises(NodeClaimNotFoundError):
+        await provider.delete("q0")
+    assert "q0" not in cloud.queuedresources.resources
+
+
+# --- bulk list fast path ----------------------------------------------------
+
+@async_test
+async def test_list_issues_one_bulk_node_list():
+    kube, cloud, provider = provider_setup()
+    for i in range(4):
+        await provider.create(make_nodeclaim(f"bl{i}", "tpu-v5e-8"))
+    counts = {"node_lists": 0}
+    inner_list = kube.list
+
+    async def counted(cls, labels=None, namespace=None, index=None):
+        from gpu_provisioner_tpu.apis.core import Node
+        if cls is Node:
+            counts["node_lists"] += 1
+        return await inner_list(cls, labels=labels, namespace=namespace,
+                                index=index)
+
+    kube.list = counted
+    provider.kube = kube
+    instances = await provider.list()
+    assert sorted(i.name for i in instances) == [f"bl{i}" for i in range(4)]
+    assert all(i.node_provider_ids for i in instances)
+    assert counts["node_lists"] == 1, \
+        f"fast path must do ONE bulk Node list, did {counts['node_lists']}"
+
+
+@async_test
+async def test_list_fast_path_matches_legacy_output():
+    kube, cloud, provider = provider_setup()
+    await provider.create(make_nodeclaim("eq0", "tpu-v5e-8"))
+    await provider.create(make_nodeclaim("eq1", "tpu-v5p-32"))
+    fast = {i.name: i for i in await provider.list()}
+    provider.cfg.legacy_list = True
+    legacy = {i.name: i for i in await provider.list()}
+    assert fast.keys() == legacy.keys()
+    for name in fast:
+        assert fast[name] == legacy[name], f"divergence on {name}"
+
+
+# --- metrics export ---------------------------------------------------------
+
+@async_test
+async def test_cache_and_cloud_call_metrics_exported():
+    kube, cloud, provider = provider_setup(cache_ttl=60.0)
+    inst = await provider.create(make_nodeclaim("m0", "tpu-v5e-8"))
+    await provider.get(inst.id)            # a hit
+    await provider.list()                  # a cloud list call
+    assert CACHE_STATS["nodepools.get"]["hits"] >= 1
+    assert CLOUD_CALLS["nodepools.list"] >= 1
+    update_runtime_gauges(object())        # no manager: registry gauges only
+    text = generate_latest(REGISTRY).decode()
+    assert 'tpu_provisioner_instance_cache_hits{cache="nodepools.get"}' in text
+    assert 'tpu_provisioner_instance_cache_misses{cache="nodepools.get"}' in text
+    assert 'tpu_provisioner_instance_cache_coalesced{cache="nodepools.get"}' in text
+    assert 'tpu_provisioner_cloud_api_calls{endpoint="nodepools.list"}' in text
+    assert 'tpu_provisioner_cloud_api_calls{endpoint="nodepools.begin_create"}' in text

@@ -330,3 +330,63 @@ async def test_no_slice_group_no_identity_labels():
     kube, cloud, provider = setup()
     await provider.create(make_nodeclaim("plain", "tpu-v5e-8"))
     assert _identity(cloud, "plain") == (None, None, None)
+
+
+# --- providerID index path (fast _pool_name_for) ----------------------------
+
+class _ListSpy:
+    """Records every list() call's (labels, index) so tests can assert the
+    full-scan fallback was never taken."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.store = getattr(inner, "store", None)
+        self.node_list_args = []
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        if cls is Node:
+            self.node_list_args.append((labels, index))
+        return await self.inner.list(cls, labels=labels, namespace=namespace,
+                                     index=index)
+
+
+@async_test
+async def test_pool_name_for_takes_index_path_not_full_scan():
+    """With the spec.providerID index registered (envtest/operator wiring),
+    _pool_name_for must resolve through the index — never the O(nodes)
+    unfiltered Node scan."""
+    kube, cloud, provider = setup()
+    kube.store.add_index(Node, "spec.providerID",
+                         lambda o: [o.spec.provider_id])
+    inst = await provider.create(make_nodeclaim("ix0", "tpu-v5e-8"))
+    spy = _ListSpy(kube)
+    provider.kube = spy
+    got = await provider.get(inst.id)
+    assert got.name == "ix0"
+    full_scans = [a for a in spy.node_list_args if a == (None, None)]
+    assert not full_scans, f"index exists but full scan taken: {spy.node_list_args}"
+    assert any(index is not None for _, index in spy.node_list_args)
+
+
+@async_test
+async def test_envtest_informer_wiring_registers_provider_id_index():
+    """Satellite check: the cached client the envtest (and real operator)
+    hands the provider must carry the providerID index, and has_index must
+    see it through the wrapper layers (chaos included)."""
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.providers.instance import has_index
+    from gpu_provisioner_tpu import chaos as chaos_mod
+
+    env = Env(EnvtestOptions(use_informer=True))
+    assert has_index(env.provider.kube), \
+        "cached client must expose the spec.providerID index"
+    env2 = Env(EnvtestOptions(use_informer=True,
+                              chaos=chaos_mod.ChaosPolicy(seed=1)))
+    assert has_index(env2.provider.kube), \
+        "index must be visible through informer+chaos layering"
+    env3 = Env(EnvtestOptions(chaos=chaos_mod.ChaosPolicy(seed=1)))
+    assert has_index(env3.provider.kube), \
+        "index must be visible through a bare chaos wrapper"

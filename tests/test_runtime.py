@@ -285,3 +285,107 @@ async def test_informer_serves_lists_and_tracks_watch():
         assert fresh.metadata.labels["grp"] == "b"
     finally:
         await client.stop()
+
+
+@async_test
+async def test_cached_list_client_index_follows_updates():
+    """Field-index and label-index bookkeeping across updates: an updated
+    providerID/label must be discoverable under its new value and gone from
+    the old one (stale index entries would feed _pool_name_for wrong pools)."""
+    from gpu_provisioner_tpu.apis.core import Node
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+    from gpu_provisioner_tpu.runtime.informer import CachedListClient
+
+    inner = InMemoryClient()
+    for n in _informer_test_objs():
+        await inner.create(n)
+    client = CachedListClient(inner, (Node,))
+    client.add_index(Node, "spec.providerID", lambda o: [o.spec.provider_id])
+    await client.start()
+    try:
+        got = await inner.get(Node, "n2")
+        got.spec.provider_id = "gce://p/z/moved"
+        got.metadata.labels["grp"] = "a"
+        await inner.update(got)
+        await asyncio.sleep(0.05)
+        (hit,) = await client.list(Node, index=("spec.providerID",
+                                                "gce://p/z/moved"))
+        assert hit.metadata.name == "n2"
+        assert await client.list(Node, index=("spec.providerID",
+                                              "gce://p/z/i2")) == []
+        # and the lookup is served by the inverted map, not a key_fn scan
+        inf = client._informers[Node]
+        assert ("spec.providerID", "gce://p/z/moved") in inf._by_index
+        assert not inf._by_index.get(("spec.providerID", "gce://p/z/i2"))
+        assert len(await client.list(Node, labels={"grp": "a"})) == 3
+        assert await client.list(Node, labels={"grp": "b"}) == []
+        # removal: a deleted object leaves no index residue
+        await inner.delete(Node, "n2")
+        await asyncio.sleep(0.05)
+        assert await client.list(Node, index=("spec.providerID",
+                                              "gce://p/z/moved")) == []
+        assert len(await client.list(Node, labels={"grp": "a"})) == 2
+    finally:
+        await client.stop()
+
+
+@async_test
+async def test_cached_list_client_cache_age_staleness():
+    """cache_age: 0.0 for uncached/unsynced kinds (reads pass through and
+    are always fresh), small once synced, and growing when the watch goes
+    quiet — the signal GC's _cache_too_stale bound consumes."""
+    from gpu_provisioner_tpu.apis.core import Node, Pod
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+    from gpu_provisioner_tpu.runtime.informer import CachedListClient
+
+    inner = InMemoryClient()
+    client = CachedListClient(inner, (Node,))
+    assert client.cache_age(Pod) == 0.0          # kind not cached
+    assert client.cache_age(Node) == 0.0         # not synced yet
+    await client.start()
+    try:
+        assert 0.0 <= client.cache_age(Node) < 1.0
+        inf = client._informers[Node]
+        inf.last_sync -= 1234.0                  # simulate a wedged watch
+        assert client.cache_age(Node) > 1000.0
+    finally:
+        await client.stop()
+
+
+@async_test
+async def test_cached_list_client_label_list_parity_with_raw_client():
+    """list-with-labels through the informer must match the raw client
+    byte-for-byte (names + labels) across creates, updates and deletes."""
+    from gpu_provisioner_tpu.apis.core import Node, NodeSpec
+    from gpu_provisioner_tpu.apis.meta import ObjectMeta
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+    from gpu_provisioner_tpu.runtime.informer import CachedListClient
+
+    inner = InMemoryClient()
+    for i in range(6):
+        await inner.create(Node(
+            metadata=ObjectMeta(name=f"p{i}", labels={
+                "pool": f"pool{i % 3}", "zone": "a" if i % 2 else "b"}),
+            spec=NodeSpec(provider_id=f"gce://p/z/p{i}")))
+    client = CachedListClient(inner, (Node,))
+    await client.start()
+    try:
+        async def parity(labels):
+            raw = sorted(n.metadata.name
+                         for n in await inner.list(Node, labels=labels))
+            cached = sorted(n.metadata.name
+                            for n in await client.list(Node, labels=labels))
+            assert cached == raw, f"labels={labels}: {cached} != {raw}"
+
+        for sel in (None, {"pool": "pool0"}, {"zone": "a"},
+                    {"pool": "pool1", "zone": "b"}, {"pool": "nope"}):
+            await parity(sel)
+        await inner.delete(Node, "p0")
+        got = await inner.get(Node, "p3")
+        got.metadata.labels["pool"] = "pool9"
+        await inner.update(got)
+        await asyncio.sleep(0.05)
+        for sel in (None, {"pool": "pool0"}, {"pool": "pool9"}):
+            await parity(sel)
+    finally:
+        await client.stop()
