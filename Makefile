@@ -30,8 +30,12 @@ e2etests: ## e2e suite: real operator subprocess vs HTTP fakes (Makefile:177-187
 CHAOS_SEED ?= 7
 
 .PHONY: chaos
-chaos: ## Chaos soak suite under a fixed seed (see docs/FAILURE_MODES.md)
-	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py -q -m chaos
+chaos: ## Chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_MODES.md)
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py tests/test_recovery.py -q -m chaos
+
+.PHONY: recover
+recover: ## Crash-restart recovery soaks: crash-point matrix + fenced leader failover
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_recovery.py -q -m recovery
 
 .PHONY: e2etests-real
 e2etests-real: ## Same specs against a live cluster (suite_test.go:34-45 mode).

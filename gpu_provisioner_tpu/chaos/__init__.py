@@ -1,12 +1,15 @@
 """Chaos-injection subsystem: seeded fault policies for the simulated cloud
-and kube client, plus the named profiles the soak suite runs under."""
+and kube client, the named profiles the soak suite runs under, and the
+crash-point schedule the crash-restart recovery suite drives."""
 
 from .client import ChaosClient, ChaosClientError, transient_kube
+from .crash import CRASH_POINTS, CrashPoints, SimulatedCrash
 from .policy import (
     ChaosPolicy, FaultRule, PROFILES, profile, stockout, transient,
 )
 
 __all__ = [
-    "ChaosClient", "ChaosClientError", "ChaosPolicy", "FaultRule",
-    "PROFILES", "profile", "stockout", "transient", "transient_kube",
+    "CRASH_POINTS", "ChaosClient", "ChaosClientError", "ChaosPolicy",
+    "CrashPoints", "FaultRule", "PROFILES", "SimulatedCrash", "profile",
+    "stockout", "transient", "transient_kube",
 ]

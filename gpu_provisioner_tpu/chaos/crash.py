@@ -1,0 +1,127 @@
+"""Crash-point injection: deterministic process-death simulation.
+
+The reference provisioner survives process death by construction — cloud
+creates are idempotent and a restarted replica re-drives every NodeClaim
+from the API server (SURVEY §1 L4/L5) — but nothing in its test suite ever
+*kills* it mid-operation. This module names the cut lines where an operator
+death strands the most interesting state and gives the envtest restart
+harness (``envtest.RestartableEnv``) a deterministic way to die there.
+
+``SimulatedCrash`` derives from ``BaseException`` (like KeyboardInterrupt)
+on purpose: every resilience layer in the operator catches ``Exception`` —
+workqueue error backoff, GC's keep-ticking guard, the lifecycle
+sub-reconciler aggregation — and a simulated process death must not be
+absorbed as one more retryable error. It rips through to the task boundary;
+the harness observes ``CrashPoints.crashed`` and tears the incarnation down
+the way the kernel would: tasks cancelled, in-memory state gone, cloud and
+kube state persisting.
+
+Determinism follows the ``ChaosPolicy`` convention: probabilistic arming
+draws are a pure hash of ``(seed, point, key, nth hit)``, so a crash
+schedule reproduces for a fixed seed regardless of reconcile interleaving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from collections import defaultdict
+from typing import Optional, Union
+
+# The named cut lines, each chosen for the state it strands (see
+# docs/FAILURE_MODES.md "Crash & restart taxonomy"):
+CRASH_POINTS = (
+    # queued resource created in the cloud, nothing recorded on the claim
+    "after_qr_create",
+    # create LRO issued, never polled — pool stranded PROVISIONING
+    "after_pool_begin_create",
+    # create LRO completed server-side, result never observed/recorded
+    "before_lro_done",
+    # delete LRO issued (queued resource already cleaned up), never polled
+    "mid_delete_after_pool_delete",
+    # node tainted, evictions queued in-memory, drain unfinished
+    "mid_drain",
+)
+
+
+class SimulatedCrash(BaseException):
+    """Injected process death. BaseException so no retry/backoff layer can
+    absorb it — it must reach the task boundary like a real crash."""
+
+
+class CrashPoints:
+    """An armable crash schedule shared across operator incarnations.
+
+    ``at`` arms one point (fire on the next eligible hit) or a mapping of
+    ``{point: times}``; ``after`` skips the first N hits of each armed point
+    so a test can crash on the Nth create rather than the first. ``rate``
+    below 1.0 makes each eligible hit a seeded keyed-hash draw (the
+    ``ChaosPolicy`` trick: independent of scheduling order).
+
+    Budgets persist across incarnations: hand the same object to the
+    restarted operator and an exhausted point stays quiet, which is exactly
+    the crash-once-then-recover shape the soak matrix drives.
+    """
+
+    def __init__(self, at: Union[str, dict, None] = None, times: int = 1,
+                 after: int = 0, rate: float = 1.0, seed: int = 0):
+        self._budget: dict[str, int] = {}
+        self._after: dict[str, int] = {}
+        self.rate = rate
+        self.seed = seed
+        # observability for harness/soak assertions
+        self.hits: dict[str, int] = defaultdict(int)
+        self.fired: dict[str, int] = defaultdict(int)
+        self.last: Optional[tuple[str, str]] = None
+        self.crashed = asyncio.Event()
+        if at is not None:
+            if isinstance(at, str):
+                self.arm(at, times=times, after=after)
+            else:
+                for point, n in dict(at).items():
+                    self.arm(point, times=n, after=after)
+
+    def arm(self, point: str, times: int = 1, after: int = 0) -> "CrashPoints":
+        """(Re-)arm ``point`` to fire ``times`` more times, skipping its next
+        ``after`` hits. Chainable; callable mid-test between incarnations."""
+        if point not in CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {point!r}; known: {CRASH_POINTS}")
+        self._budget[point] = self._budget.get(point, 0) + times
+        self._after[point] = self.hits[point] + after
+        return self
+
+    def _draw(self, *key) -> float:
+        h = hashlib.sha256(repr((self.seed,) + key).encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2 ** 64
+
+    def hit(self, point: str, key: str = "") -> None:
+        """Instrumented code marks a cut line; raises ``SimulatedCrash`` when
+        the point is armed. A no-op for unarmed points (production passes no
+        ``CrashPoints`` at all, so the seam costs one None check)."""
+        if point not in CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {point!r}; known: {CRASH_POINTS}")
+        n = self.hits[point]
+        self.hits[point] = n + 1
+        if self._budget.get(point, 0) <= 0 or n < self._after.get(point, 0):
+            return
+        if self.rate < 1.0 and self._draw(point, key, n) >= self.rate:
+            return
+        self._budget[point] -= 1
+        self.fired[point] += 1
+        self.last = (point, key)
+        self.crashed.set()
+        raise SimulatedCrash(f"simulated crash at {point} ({key})")
+
+    def disarm(self, point: Optional[str] = None) -> "CrashPoints":
+        """Zero the budget of ``point`` (or all points): the next incarnation
+        runs clean. Hit/fired counters are preserved for assertions."""
+        if point is None:
+            self._budget.clear()
+        else:
+            self._budget.pop(point, None)
+        return self
+
+    def fired_total(self) -> int:
+        return sum(self.fired.values())

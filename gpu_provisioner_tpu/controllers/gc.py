@@ -79,6 +79,15 @@ class InstanceGCController:
         self.client = client
         self.cp = cloudprovider
         self.opts = options or GCOptions()
+        # name -> monotonic time this instance was FIRST observed orphaned.
+        # Cloud creation timestamps come from a second-resolution label, so
+        # "age > grace" alone brands a just-created instance one full
+        # second old the moment the wall clock rolls — with the fake cloud
+        # now settling creates server-side (crash-restart realism), that
+        # raced in-flight direct creates. An orphan is reaped when its
+        # label age EXCEEDS the grace by the 1s truncation error, or when
+        # this controller has itself observed it orphaned for the grace.
+        self._orphan_since: dict[str, float] = {}
 
     async def run_once(self) -> float:
         try:
@@ -95,13 +104,20 @@ class InstanceGCController:
         claims = {nc.metadata.name for nc in await list_managed(self.client)}
 
         leaked = []
+        mono = asyncio.get_event_loop().time()
+        orphan_since: dict[str, float] = {}
         for inst in instances:
             if inst.metadata.name in claims:
                 continue
+            first = orphan_since[inst.metadata.name] = \
+                self._orphan_since.get(inst.metadata.name, mono)
             age = (now() - inst.metadata.creation_timestamp).total_seconds() \
                 if inst.metadata.creation_timestamp else 0.0
-            if age > self.opts.leak_grace:
+            if (age - 1.0 > self.opts.leak_grace
+                    or mono - first > self.opts.leak_grace):
                 leaked.append(inst)
+        # instances that regained a claim or vanished restart their clock
+        self._orphan_since = orphan_since
 
         if leaked:
             log.info("instance GC: deleting %d leaked slices: %s",
@@ -114,6 +130,10 @@ class InstanceGCController:
                         await self.cp.delete(inst)
                     except NodeClaimNotFoundError:
                         pass
+                    # forget the reaped orphan's first-seen clock: a
+                    # same-named pool recreated later must start a fresh
+                    # observed-for window, not inherit this one's
+                    self._orphan_since.pop(inst.metadata.name, None)
             await asyncio.gather(*(reap(i) for i in leaked))
 
         await self._collect_orphan_nodes(claims, instances)

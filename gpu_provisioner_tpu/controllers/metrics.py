@@ -77,6 +77,29 @@ WORKQUEUE_REQUEUES = _get_or_create(
     "Cumulative rate-limited requeues (sampled from the queue counter).",
     ["controller"])
 
+# --------------------------------------------------------- crash recovery
+
+RECOVERY_ADOPTED = _get_or_create(
+    Counter, "tpu_provisioner_recovery_adopted",
+    "Half-created cloud resources (with a living NodeClaim) adopted by the "
+    "startup resync pass; the lifecycle re-drive resumes them.", ["resource"])
+
+RECOVERY_REAPED = _get_or_create(
+    Counter, "tpu_provisioner_recovery_reaped",
+    "Orphaned cloud resources (NodeClaim gone) reaped by the startup "
+    "resync pass ahead of the GC interval.", ["resource"])
+
+RECOVERY_RESUMED = _get_or_create(
+    Counter, "tpu_provisioner_recovery_resumed",
+    "Queued resources found mid-ladder with a living NodeClaim; the queued "
+    "create path re-enters the ladder where the dead incarnation left it.",
+    ["resource"])
+
+FENCED_RECONCILES = _get_or_create(
+    Gauge, "tpu_provisioner_fenced_reconciles",
+    "Reconciles dropped because this replica's fencing token went stale "
+    "(deposed leader; sampled).", ["controller"])
+
 # 0 = closed, 1 = half-open, 2 = open (alert on >= 1).
 BREAKER_STATE = _get_or_create(
     Gauge, "tpu_provisioner_circuit_breaker_state",
@@ -141,6 +164,7 @@ def update_runtime_gauges(manager) -> None:
         WORKQUEUE_DELAYED.labels(c.name).set(q.delayed())
         WORKQUEUE_RETRYING.labels(c.name).set(q.retrying())
         WORKQUEUE_REQUEUES.labels(c.name).set(q.requeues_total)
+        FENCED_RECONCILES.labels(c.name).set(c.fenced_total)
     for name, stats in CACHE_STATS.items():
         for stat, gauge in _CACHE_GAUGES:
             gauge.labels(name).set(stats[stat])

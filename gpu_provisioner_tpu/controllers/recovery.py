@@ -1,0 +1,188 @@
+"""Startup resync / orphan adoption — the crash-restart recovery pass.
+
+A restarted (or newly-elected) operator inherits whatever a dead
+incarnation stranded in the cloud: pools mid-create with a living
+NodeClaim, queued resources mid-ladder, and half-deleted or claimless
+resources nothing will ever finish. The watch replay re-drives every
+NodeClaim through the normal controllers (store.watch initial-list
+semantics), so per-claim *resumption* needs no special casing — the
+idempotent create / conflict-adoption path in ``providers/instance.py``
+picks the work back up. What the replay can NOT see is cloud state with no
+claim behind it: that leaks until the next instance-GC tick (minutes).
+
+This singleton runs one audit pass at boot — i.e. immediately after this
+replica becomes leader, since the manager only starts then — and then
+re-audits at a slow cadence as insurance:
+
+- **adopt**   a pool whose NodeClaim still exists but whose launch never
+              recorded: counted (``tpu_provisioner_recovery_adopted``);
+              the lifecycle re-drive resumes the LRO.
+- **reap**    a pool or queued resource whose NodeClaim is gone: deleted
+              NOW instead of waiting out the GC interval
+              (``tpu_provisioner_recovery_reaped``).
+- **resume**  a queued resource mid-ladder with a living claim: counted
+              (``tpu_provisioner_recovery_resumed``); the queued create
+              path re-enters the ladder where it left off.
+
+Ordering makes orphan detection race-free without a grace window: a
+NodeClaim always exists before its pool/QR is created, so listing cloud
+resources FIRST and claims SECOND means a resource whose claim is absent
+from the later claim list is a true orphan, not a creation race. The pass
+still refuses to act on a stale cached claim view (same watch-age guard as
+GC): reaping on a wedged informer would delete live capacity.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis.karpenter import LAUNCHED, NodeClaim
+from ..errors import NodeClaimNotFoundError
+from ..providers.gcp import (
+    NP_ERROR, NP_PROVISIONING, NP_STOPPING, QR_ACTIVE,
+)
+from ..providers.instance import (
+    parse_ts_label, pool_created_from_nodeclaim, pool_owned_by_kaito,
+)
+from ..apis import labels as wk
+from ..apis.serde import now
+from ..runtime.client import Client
+from .gc import _cache_too_stale, GCOptions
+from .metrics import RECOVERY_ADOPTED, RECOVERY_REAPED, RECOVERY_RESUMED
+from .utils import list_managed
+
+log = logging.getLogger("controllers.recovery")
+
+
+@dataclass
+class RecoveryOptions:
+    # Boot pass fires immediately (singleton semantics); afterwards the
+    # audit repeats at this slow cadence as insurance — GC owns steady-state.
+    interval: float = 600.0
+    # Skip cloud resources younger than this (creation-timestamp label,
+    # second resolution) — the same leak grace GC applies. The
+    # pools-then-claims ordering makes orphan detection race-free for the
+    # controller path, but direct provider use (tests, manual tooling)
+    # creates pools no claim ever backs.
+    grace: float = 30.0
+    # Refuse to reap on a stale cached claim view (GC's watch-age bound).
+    max_cache_age: float = 600.0
+
+
+class RecoveryController:
+    NAME = "operator.recovery"
+
+    def __init__(self, client: Client, cloudprovider,
+                 options: Optional[RecoveryOptions] = None):
+        self.client = client
+        self.cp = cloudprovider
+        self.opts = options or RecoveryOptions()
+        # count each (fate, resource) once per incarnation, not once per pass
+        self._counted: set[tuple[str, str, str]] = set()
+
+    @property
+    def provider(self):
+        # InstanceProvider behind the metrics decorator (both the decorator
+        # and the bare TPUCloudProvider expose .instances)
+        return self.cp.instances
+
+    async def run_once(self) -> float:
+        try:
+            await self._resync()
+        except Exception as e:  # noqa: BLE001 — recovery must keep ticking
+            log.warning("recovery pass failed: %s", e, exc_info=True)
+        return self.opts.interval
+
+    async def _resync(self) -> None:
+        gc_guard = GCOptions(max_cache_age=self.opts.max_cache_age)
+        if _cache_too_stale(self.client, gc_guard, self.NAME, NodeClaim):
+            return
+        provider = self.provider
+        # cloud FIRST, claims SECOND — see module docstring
+        pools = await provider.nodepools.list()
+        queued = (await provider.queued.list()
+                  if provider.queued is not None else [])
+        claims = {nc.metadata.name: nc
+                  for nc in await list_managed(self.client)}
+
+        for pool in pools:
+            if not (pool_owned_by_kaito(pool)
+                    and pool_created_from_nodeclaim(pool)):
+                continue
+            nc = claims.get(pool.name)
+            if nc is None:
+                # STOPPING: a delete is already in flight. PROVISIONING: a
+                # create is in flight — possibly a direct provider.create
+                # racing this pass (no claim ever backs those) — and the
+                # verdict belongs to GC once the pool settles; reaping here
+                # would yank a pool out from under a live node wait.
+                if (pool.status in (NP_STOPPING, NP_PROVISIONING)
+                        or self._young(pool)):
+                    continue
+                await self._reap_pool(pool.name)
+            elif (nc.metadata.deletion_timestamp is None
+                  and (pool.status in (NP_PROVISIONING, NP_ERROR)
+                       or not nc.status_conditions.is_true(LAUNCHED))):
+                # half-created: a previous incarnation died mid-create; the
+                # lifecycle re-drive resumes it through conflict adoption
+                self._count("pool", pool.name, RECOVERY_ADOPTED,
+                            "adopting half-created pool")
+
+        for qr in queued:
+            nc = claims.get(qr.name)
+            if nc is None:
+                await self._reap_qr(qr.name)
+            elif (qr.state != QR_ACTIVE
+                  and nc.metadata.deletion_timestamp is None):
+                self._count("qr", qr.name, RECOVERY_RESUMED,
+                            "resuming queued-resource ladder")
+
+    def _young(self, pool) -> bool:
+        if self.opts.grace <= 0:
+            return False
+        created = parse_ts_label(
+            pool.config.labels.get(wk.KAITO_CREATION_TIMESTAMP_LABEL, ""))
+        if created is None:
+            return False
+        # -1.0: the creation label is second-truncated, so the raw age
+        # over-reports by up to a second — reap only on the age LOWER bound
+        # (fresh orphans that slip through fall to GC's observed-for grace)
+        return (now() - created).total_seconds() - 1.0 < self.opts.grace
+
+    def _count(self, kind: str, name: str, counter, what: str) -> None:
+        # dedup per (fate, resource): the SAME resource can legitimately be
+        # counted under different counters across passes (adopted at boot,
+        # reaped after its claim dies) — only repeat observations of the
+        # same fate are suppressed
+        key = (counter._name, kind, name)
+        if key in self._counted:
+            return
+        self._counted.add(key)
+        counter.labels(kind).inc()
+        log.info("recovery: %s %s", what, name)
+
+    async def _reap_pool(self, name: str) -> None:
+        # provider.delete is the full teardown (queued cleanup first, then
+        # the pool) and is idempotent against concurrent GC/termination
+        try:
+            await self.provider.delete(name)
+        except NodeClaimNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — per-item; GC is the backstop
+            log.warning("recovery: reaping orphan pool %s failed: %s", name, e)
+            return
+        self._count("pool", name, RECOVERY_REAPED, "reaped orphan pool")
+
+    async def _reap_qr(self, name: str) -> None:
+        try:
+            # the provider's fenced QR-teardown path (NotFound is success):
+            # a deposed leader's in-flight audit must not delete a queued
+            # resource the new leader may be driving
+            await self.provider.delete_queued(name)
+        except Exception as e:  # noqa: BLE001 — per-item; GC is the backstop
+            log.warning("recovery: reaping orphan queued resource %s "
+                        "failed: %s", name, e)
+            return
+        self._count("qr", name, RECOVERY_REAPED, "reaped orphan queued resource")

@@ -22,6 +22,7 @@ from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
 from .metrics import RECONCILE_RETRIES_EXHAUSTED, RECONCILE_TIMEOUTS
+from .recovery import RecoveryController, RecoveryOptions
 from .slicegroup import SliceGroupController, group_requests
 from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
 from .utils import shard_owns
@@ -59,6 +60,9 @@ def build_controllers(client: Client, cloudprovider,
                       # could (the ladder's cumulative delay at 30 exceeds
                       # any configured launch timeout's first check).
                       max_retries: int = 30,
+                      recovery_options: Optional[RecoveryOptions] = None,
+                      crashes=None,
+                      fence=None,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -81,7 +85,15 @@ def build_controllers(client: Client, cloudprovider,
     persistently-failing item degrades to slow retry after ``max_retries``
     requeues — both are counted in the tpu_provisioner_reconcile_* metric
     families, and retry exhaustion on a NodeClaim also publishes a Warning
-    event on the claim."""
+    event on the claim.
+
+    Crash-restart recovery wiring: ``crashes`` (chaos.CrashPoints) arms the
+    mid_drain cut line in the termination controller; the startup
+    resync/orphan-adoption singleton (controllers/recovery.py) runs on
+    shard 0 alongside the GC loops; ``fence`` (a leadership FencingToken)
+    is applied to EVERY controller — including the cloud-mutating GC and
+    recovery singletons — so a deposed leader's workers drop items instead
+    of reconciling."""
     if not 0 <= shard_index < shards:
         raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
     owns = (lambda name: True) if shards == 1 else \
@@ -104,7 +116,8 @@ def build_controllers(client: Client, cloudprovider,
                                             lifecycle_options)
     eviction = EvictionQueue(client, recorder=recorder)
     termination = NodeTerminationController(client, cloudprovider, eviction,
-                                            recorder, termination_options)
+                                            recorder, termination_options,
+                                            crashes=crashes)
 
     hardening = dict(reconcile_timeout=reconcile_timeout,
                      max_retries=max_retries)
@@ -121,10 +134,15 @@ def build_controllers(client: Client, cloudprovider,
         instance_gc = InstanceGCController(client, cloudprovider, gc_options)
         nodeclaim_gc = NodeClaimGCController(client, cloudprovider,
                                              gc_options)
+        recovery = RecoveryController(client, cloudprovider, recovery_options)
         controllers += [
             Controller(instance_gc.NAME, Singleton(instance_gc.run_once),
                        max_concurrent=1).as_singleton(),
             Controller(nodeclaim_gc.NAME, Singleton(nodeclaim_gc.run_once),
+                       max_concurrent=1).as_singleton(),
+            # boot-time resync: the singleton request fires at manager
+            # start, i.e. immediately after leadership is won
+            Controller(recovery.NAME, Singleton(recovery.run_once),
                        max_concurrent=1).as_singleton(),
             Controller(SliceGroupController.NAME,
                        SliceGroupController(client, cluster=cluster),
@@ -142,6 +160,7 @@ def build_controllers(client: Client, cloudprovider,
     for c in controllers:
         c.set_metrics_hook(_reconcile_metrics_hook)
         c.set_exhausted_hook(exhausted_hook)
+        c.fence = fence
     return controllers, eviction
 
 

@@ -158,12 +158,21 @@ class NodeTerminationController:
 
     def __init__(self, client: Client, cloudprovider, queue: EvictionQueue,
                  recorder: Optional[Recorder] = None,
-                 options: Optional[TerminationOptions] = None):
+                 options: Optional[TerminationOptions] = None,
+                 crashes=None):
         self.client = client
         self.cp = cloudprovider
         self.queue = queue
         self.recorder = recorder
         self.opts = options or TerminationOptions()
+        # chaos.CrashPoints (None in production): the mid_drain cut line —
+        # evictions queued in-memory, drain unfinished — lives here because
+        # the eviction queue's parked state is exactly what a crash loses.
+        self.crashes = crashes
+
+    def _crash(self, point: str, key: str) -> None:
+        if self.crashes is not None:
+            self.crashes.hit(point, key)
 
     async def reconcile(self, req: Request) -> Result:
         try:
@@ -190,6 +199,10 @@ class NodeTerminationController:
         if not await self._instance_gone(node):
             if not self._grace_expired(nc):
                 drained = await self._drain(node)
+                if not drained:
+                    # cut line: pods are parked in the in-memory eviction
+                    # queue and nothing durable records the drain progress
+                    self._crash("mid_drain", node.metadata.name)
                 if nc is not None:
                     await self._set_cond(nc, DRAINED, drained, "Draining")
                 if not drained:

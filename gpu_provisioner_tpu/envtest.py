@@ -21,6 +21,7 @@ from .cloudprovider import MetricsDecorator, TPUCloudProvider
 from .controllers.gc import GCOptions
 from .controllers.health import HealthOptions
 from .controllers.lifecycle import LifecycleOptions
+from .controllers.recovery import RecoveryOptions
 from .controllers.registry import build_controllers
 from .controllers.termination import TerminationOptions
 from .fake.cloud import FakeCloud
@@ -75,24 +76,50 @@ class EnvtestOptions:
     # deadline and per-item retry bound for the per-object controllers.
     reconcile_timeout: Optional[float] = None
     max_reconcile_retries: int = 30
+    # Crash-point schedule (chaos.CrashPoints): armed cut lines raise
+    # SimulatedCrash through the operator; the SAME object is handed to
+    # every incarnation a RestartableEnv boots, so budgets persist across
+    # restarts (crash once, then recover clean).
+    crashes: object = None
+    # Startup resync/orphan-adoption cadence (controllers/recovery.py);
+    # the boot pass always fires immediately.
+    recovery_interval: float = 600.0
+
+
+def _make_cloud(opts: EnvtestOptions, client: InMemoryClient) -> FakeCloud:
+    return FakeCloud(
+        client,
+        create_latency=opts.create_latency,
+        delete_latency=opts.delete_latency,
+        node_join_delay=opts.node_join_delay,
+        node_ready_delay=opts.node_ready_delay,
+        qr_step_latency=opts.qr_step_latency,
+        chaos=opts.chaos)
 
 
 class Env:
-    """One in-process provisioner: store + fake cloud + full controller set."""
+    """One in-process provisioner: store + fake cloud + full controller set.
 
-    def __init__(self, options: Optional[EnvtestOptions] = None):
+    ``client``/``cloud`` may be supplied to build an operator *incarnation*
+    over pre-existing durable state (the crash-restart harness,
+    :class:`RestartableEnv`); by default each Env owns a fresh store and
+    cloud. ``fence`` is a leadership fencing token applied to every
+    controller and the instance provider.
+    """
+
+    def __init__(self, options: Optional[EnvtestOptions] = None,
+                 client: Optional[InMemoryClient] = None,
+                 cloud: Optional[FakeCloud] = None,
+                 fence=None):
         self.opts = options or EnvtestOptions()
-        self.client = InMemoryClient()
+        self.client = client if client is not None else InMemoryClient()
         self.client.store.add_index(Node, "spec.providerID",
                                     lambda o: [o.spec.provider_id])
-        self.cloud = FakeCloud(
-            self.client,
-            create_latency=self.opts.create_latency,
-            delete_latency=self.opts.delete_latency,
-            node_join_delay=self.opts.node_join_delay,
-            node_ready_delay=self.opts.node_ready_delay,
-            qr_step_latency=self.opts.qr_step_latency,
-            chaos=self.opts.chaos)
+        if cloud is None:
+            cloud = _make_cloud(self.opts, self.client)
+        elif self.opts.chaos is not None and cloud.chaos is not self.opts.chaos:
+            cloud.set_chaos(self.opts.chaos)
+        self.cloud = cloud
         self.chaos = self.opts.chaos
         kube = self.client
         if self.chaos is not None:
@@ -118,7 +145,8 @@ class Env:
                 cache_ttl=self.opts.instance_cache_ttl,
                 qr_cache_ttl=0.0,
                 cache_negative_ttl=self.opts.instance_cache_negative_ttl),
-            queued=self.cloud.queuedresources)
+            queued=self.cloud.queuedresources,
+            crashes=self.opts.crashes, fence=fence)
         self.cloudprovider = MetricsDecorator(TPUCloudProvider(
             self.provider, repair_toleration=self.opts.repair_toleration))
         self.recorder = Recorder(self.client)
@@ -133,7 +161,11 @@ class Env:
             max_concurrent_reconciles=self.opts.max_concurrent_reconciles,
             shards=self.opts.shards, shard_index=self.opts.shard_index,
             reconcile_timeout=self.opts.reconcile_timeout,
-            max_retries=self.opts.max_reconcile_retries)
+            max_retries=self.opts.max_reconcile_retries,
+            recovery_options=RecoveryOptions(
+                interval=self.opts.recovery_interval,
+                grace=self.opts.leak_grace),
+            crashes=self.opts.crashes, fence=fence)
         self.manager = Manager(self.client).register(*controllers)
 
     async def __aenter__(self) -> "Env":
@@ -193,3 +225,81 @@ class Env:
                     f"nodeclaim {name} not {what} after {timeout}s; conditions: {conds}")
             await asyncio.sleep(interval)
             interval = min(interval * 1.3, 0.25)
+
+
+class RestartableEnv:
+    """Crash-restart harness: the durable half (kube store + fake cloud)
+    outlives the operator incarnations built on top of it.
+
+    ``start()`` boots an incarnation — fresh provider caches, informers,
+    controllers, eviction queue, everything in-memory — against the SAME
+    store and cloud. ``crash()`` tears the running incarnation down the way
+    process death would: every operator task cancelled, every cache
+    dropped, nothing released gracefully. Cloud and kube state persist,
+    including in-flight LROs, which the fake cloud keeps driving
+    server-side (``FakeNodePoolsAPI._settle``) exactly as GKE would for an
+    operator that died mid-poll.
+
+    The usual shape, with a ``chaos.CrashPoints`` schedule in
+    ``options.crashes``::
+
+        renv = RestartableEnv(opts)
+        await renv.start()
+        ...create claims...
+        await renv.opts.crashes.crashed.wait()   # armed point fired
+        await renv.restart()                     # fresh incarnation
+        await renv.wait_ready("claim0")          # must converge
+
+    For leader-failover soaks, ``start(fence=...)`` threads a per-
+    incarnation fencing token, and a *zombie* incarnation can be kept
+    running deliberately (skip ``crash()``; boot a rival via a second
+    ``Env(opts, client=renv.client, cloud=renv.cloud, fence=...)``) to
+    prove fenced workers stop mutating the cloud.
+    """
+
+    def __init__(self, options: Optional[EnvtestOptions] = None):
+        self.opts = options or EnvtestOptions()
+        self.client = InMemoryClient()
+        self.client.store.add_index(Node, "spec.providerID",
+                                    lambda o: [o.spec.provider_id])
+        self.cloud = _make_cloud(self.opts, self.client)
+        self.env: Optional[Env] = None
+        self.incarnations = 0
+
+    async def start(self, fence=None) -> Env:
+        if self.env is not None:
+            raise RuntimeError("an incarnation is already running")
+        env = Env(self.opts, client=self.client, cloud=self.cloud,
+                  fence=fence)
+        await env.__aenter__()
+        self.env = env
+        self.incarnations += 1
+        return env
+
+    async def crash(self) -> None:
+        """Hard-kill the running incarnation. The graceful-vs-crash
+        distinctions that matter — lease release, cloud-side rollback —
+        live above this layer: nothing here releases anything."""
+        env, self.env = self.env, None
+        if env is not None:
+            await env.__aexit__()
+
+    async def restart(self, fence=None) -> Env:
+        await self.crash()
+        return await self.start(fence=fence)
+
+    async def __aenter__(self) -> "RestartableEnv":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.crash()
+
+    # current-incarnation passthroughs (the helpers only touch the durable
+    # client, so they survive a crash that happens mid-wait)
+    async def wait_ready(self, name: str, timeout: float = 10.0,
+                         poll: Optional[float] = None) -> NodeClaim:
+        return await self.env.wait_ready(name, timeout, poll)
+
+    async def wait_gone(self, name: str, timeout: float = 10.0) -> None:
+        return await self.env.wait_gone(name, timeout)

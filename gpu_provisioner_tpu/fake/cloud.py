@@ -105,38 +105,75 @@ class FakeNodePoolsAPI(_FaultInjector):
         super().__init__()
         self.cloud = cloud
         self.pools: dict[str, NodePool] = {}
+        # Server-side LRO ledger: name -> (deadline, kind, pool-at-issue).
+        # Real clouds keep executing an issued operation whether or not the
+        # client that issued it is still alive; the old fake only advanced
+        # state from the returned operation's done() poll, so an operator
+        # crash mid-create stranded the pool PROVISIONING forever. Every API
+        # entry point settles overdue operations first (crash-restart
+        # realism: a pool stranded by a dead incarnation still turns
+        # RUNNING, a STOPPING pool still disappears).
+        self._pending: dict[str, tuple[float, str, NodePool]] = {}
+
+    async def _settle(self, name: str) -> None:
+        pend = self._pending.get(name)
+        if pend is None or time.monotonic() < pend[0]:
+            return
+        deadline, kind, target = pend
+        self._pending.pop(name, None)
+        pool = self.pools.get(name)
+        if pool is not target:
+            return  # replaced since the op was issued — the op is moot
+        if kind == "create":
+            pool.status = NP_RUNNING
+            await self.cloud.join_nodes(pool)
+        elif kind == "create-error":
+            pool.status = NP_ERROR
+            pool.status_message = "chaos: create operation failed"
+        elif kind == "delete":
+            self.pools.pop(name, None)
+            if not self.cloud.leave_orphan_nodes:
+                await self.cloud.remove_nodes(name)
+
+    async def _settle_all(self) -> None:
+        for name in list(self._pending):
+            await self._settle(name)
 
     async def begin_create(self, pool: NodePool):
+        await self._settle_all()
         await self._acheck("begin_create")
-        if pool.name in self.pools and self.pools[pool.name].status == NP_PROVISIONING:
-            raise APIError(f"operation on {pool.name} already in progress", code=409)
+        existing = self.pools.get(pool.name)
+        if existing is not None and existing.status != NP_ERROR:
+            # GKE 409s any live pool (PROVISIONING, RUNNING, STOPPING);
+            # only an ERROR carcass may be re-created in place — the
+            # delete+recreate collapsed, which is the op-error soak's
+            # replace-never-duplicate contract.
+            raise APIError(f"nodepool {pool.name} already exists "
+                           f"({existing.status})", code=409)
         stored = NodePool.from_dict(pool.to_dict())
         stored.status = NP_PROVISIONING
         self.pools[pool.name] = stored
 
         # Chaos partial mode: the LRO "completes" but result() raises and the
         # pool is a dead ERROR carcass with no nodes — the caller's retry
-        # must replace it (begin_create on a non-PROVISIONING pool), not
-        # duplicate it.
+        # must replace it, not duplicate it.
+        kind, error = "create", None
         if self.chaos is not None and self.chaos.should(
                 "op_error", pool.name, per_attempt=True):
-            async def fail_finish():
-                if self.pools.get(pool.name) is stored:
-                    stored.status = NP_ERROR
-                    stored.status_message = "chaos: create operation failed"
-            return TimedOperation(
-                self.cloud.create_latency, on_done=fail_finish,
-                error=APIError(f"chaos: operation on {pool.name} failed",
-                               code=500))
+            kind = "create-error"
+            error = APIError(f"chaos: operation on {pool.name} failed",
+                             code=500)
+        self._pending[pool.name] = (
+            time.monotonic() + self.cloud.create_latency, kind, stored)
 
-        async def finish():
-            if self.pools.get(pool.name) is stored:
-                stored.status = NP_RUNNING
-                await self.cloud.join_nodes(stored)
+        async def on_done():
+            await self._settle(pool.name)
 
-        return TimedOperation(self.cloud.create_latency, result=stored, on_done=finish)
+        return TimedOperation(self.cloud.create_latency, result=stored,
+                              on_done=on_done, error=error)
 
     async def get(self, name: str) -> NodePool:
+        await self._settle_all()
         await self._acheck("get")
         pool = self.pools.get(name)
         if pool is None:
@@ -144,20 +181,23 @@ class FakeNodePoolsAPI(_FaultInjector):
         return NodePool.from_dict(pool.to_dict())
 
     async def begin_delete(self, name: str):
+        await self._settle_all()
         await self._acheck("begin_delete")
         pool = self.pools.get(name)
         if pool is None:
             raise APIError(f"nodepool {name} not found", code=404)
         pool.status = NP_STOPPING
+        # supersedes any pending create for the name: delete wins
+        self._pending[name] = (
+            time.monotonic() + self.cloud.delete_latency, "delete", pool)
 
-        async def finish():
-            self.pools.pop(name, None)
-            if not self.cloud.leave_orphan_nodes:
-                await self.cloud.remove_nodes(name)
+        async def on_done():
+            await self._settle(name)
 
-        return TimedOperation(self.cloud.delete_latency, on_done=finish)
+        return TimedOperation(self.cloud.delete_latency, on_done=on_done)
 
     async def list(self) -> list[NodePool]:
+        await self._settle_all()
         await self._acheck("list")
         return [NodePool.from_dict(p.to_dict()) for p in self.pools.values()]
 

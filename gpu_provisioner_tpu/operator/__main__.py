@@ -199,6 +199,16 @@ async def run_real(opts) -> int:
         log.info("waiting for leadership",
                  extra={"identity": elector.identity})
         await elector.run_until_leading()
+        # Leader fencing: the token captured at acquisition gates every
+        # cloud mutation (provider) and every reconcile dequeue
+        # (controllers). on_lost→stop tears the process down, but fencing
+        # closes the window where reconciles already in flight — or items
+        # already dequeued — would keep mutating the cloud while the next
+        # leader acts. Nothing has started yet, so assignment here is safe.
+        fence = elector.fence()
+        provider.fence = fence
+        for c in controllers:
+            c.fence = fence
 
     await kube.start()  # informers sync before the first reconcile
     eviction.start()

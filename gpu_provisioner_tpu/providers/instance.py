@@ -164,7 +164,8 @@ class InstanceProvider:
 
     def __init__(self, nodepools: NodePoolsAPI, kube: Client,
                  config: Optional[ProviderConfig] = None,
-                 queued: Optional[QueuedResourcesAPI] = None):
+                 queued: Optional[QueuedResourcesAPI] = None,
+                 crashes=None, fence=None):
         # every cloud seam is wrapped in a per-endpoint call counter so the
         # /metrics surface (and the bench harness) can see exactly what the
         # control loops cost the cloud APIs
@@ -173,6 +174,14 @@ class InstanceProvider:
                        if queued is not None else None)
         self.kube = kube
         self.cfg = config or ProviderConfig()
+        # Crash-point schedule (chaos.CrashPoints) marking the cut lines a
+        # process death strands the most interesting state at; None in
+        # production. Fencing token (leaderelection.FencingToken): checked
+        # before every cloud MUTATION so a reconcile that was already in
+        # flight when this replica lost the lease cannot race the new
+        # leader (the controller-level fence only gates new dequeues).
+        self.crashes = crashes
+        self.fence = fence
         # Read-through caches (providers/cache.py): point lookups on the
         # cloud seams, singleflight-coalesced, explicitly invalidated by
         # create/delete/state transitions below.
@@ -258,26 +267,97 @@ class InstanceProvider:
         pool = self._new_nodepool_object(nc, shape, capacity_type,
                                          extra_labels=slice_identity)
         try:
+            self._fence_check()
             op = await self.nodepools.begin_create(pool)
-            await poll_until_done(op)
+            self._crash("after_pool_begin_create", name)
+            # poll at the node-wait cadence: the default 1s LRO poll left a
+            # completed create unobserved for up to a full second — at
+            # envtest/production config alike, the node wait owns pacing
+            await poll_until_done(op, interval=self.cfg.node_wait_interval)
         except APIError as e:
             if e.conflict:
-                # Crash-restart tolerance: a create from a previous incarnation
-                # is still in flight — fall through to the node wait
-                # (reference: instance.go:106-110).
-                log.info("nodepool %s create already in progress, continuing", name)
+                # Crash-restart tolerance: a create from a previous
+                # incarnation (or a racing replica) owns this pool. Adopt
+                # it — resume the in-flight LRO by polling the pool's own
+                # state — rather than blind-waiting for nodes a pool that
+                # lands in ERROR will never produce (reference:
+                # instance.go:106-110, minus its blind wait).
+                log.info("nodepool %s create already in progress, adopting", name)
+                await self._adopt_inflight_create(name)
             elif e.exhausted:
                 raise InsufficientCapacityError(
                     f"nodepool {name} ({shape.slice_name}): {e}") from e
             else:
                 raise CreateError(f"creating nodepool {name}: {e}") from e
 
+        # cut line: the create LRO has completed server-side but nothing —
+        # cache invalidation, node wait, claim status — has recorded it yet
+        self._crash("before_lro_done", name)
         nodes = await self._wait_for_nodes(name, shape.hosts)
         # state transition just happened (create LRO completed) — drop any
         # entry cached during the wait so the final read sees RUNNING
         self._pool_cache.invalidate(name)
         created = await self._get_pool(name)
         return self._to_instance(created, shape=shape, nodes=nodes)
+
+    def _crash(self, point: str, key: str) -> None:
+        if self.crashes is not None:
+            self.crashes.hit(point, key)
+
+    def _fence_check(self) -> None:
+        # Single-writer guard: raises FencedError for a deposed leader. The
+        # error is deliberately not an APIError — it takes the generic
+        # workqueue error path, which a dying incarnation's fenced workers
+        # then drop on dequeue.
+        if self.fence is not None:
+            self.fence.check()
+
+    async def _adopt_inflight_create(self, name: str) -> None:
+        """Resume another incarnation's in-flight create: poll the pool's
+        state until it leaves PROVISIONING within the node-wait budget.
+
+        The old behavior fell straight through to ``_wait_for_nodes``, which
+        blind-waits against a pool that may have landed in ERROR — burning
+        the whole wait budget (and a slice of the launch liveness budget)
+        per retry on a pool that will never produce nodes. ERROR/degraded
+        pools now surface as a terminal ``CreateError`` immediately; the
+        retry's ``begin_create`` replaces the carcass. Reads go through the
+        read-through cache (coalesced; ttl ≪ budget) and, against the fake
+        cloud, drive the server-side LRO settle."""
+        budget = self.cfg.node_wait_attempts * self.cfg.node_wait_interval
+        deadline = asyncio.get_event_loop().time() + budget
+        interval = self.cfg.node_wait_interval
+        while True:
+            try:
+                pool = await self._get_pool(name)
+            except APIError as e:
+                if e.not_found:
+                    self._pool_cache.invalidate(name)
+                    raise CreateError(
+                        f"nodepool {name} vanished while adopting an "
+                        "in-flight create; requeueing",
+                        reason="CreateInProgress") from e
+                raise CreateError(f"adopting nodepool {name}: {e}") from e
+            if pool.status == NP_ERROR:
+                self._pool_cache.invalidate(name)
+                raise CreateError(
+                    f"nodepool {name} is ERROR after an adopted create: "
+                    f"{pool.status_message or 'unknown failure'}",
+                    reason="DegradedPool")
+            if pool.status == NP_STOPPING:
+                self._pool_cache.invalidate(name)
+                raise CreateError(
+                    f"nodepool {name} is being deleted; requeueing",
+                    reason="CreateInProgress")
+            if pool.status != NP_PROVISIONING:
+                return  # RUNNING/RECONCILING — fall through to the node wait
+            if asyncio.get_event_loop().time() >= deadline:
+                raise CreateError(
+                    f"nodepool {name} still PROVISIONING after {budget:.0f}s "
+                    "adopted-create wait; requeueing",
+                    reason="CreateInProgress")
+            await asyncio.sleep(interval)
+            interval = min(interval * 1.5, budget / 4)
 
     def _queued_mode(self, nc: NodeClaim, reqs: Requirements) -> bool:
         if self.queued is None:
@@ -305,9 +385,12 @@ class InstanceProvider:
             if not e.not_found:
                 raise CreateError(f"getting queued resource {name}: {e}") from e
             self._qr_cache.invalidate(name)  # drop the negative entry …
+            self._fence_check()
             qr = await self.queued.create(QueuedResource(
                 name=name, accelerator_type=shape.slice_name, node_pool=name,
                 spot=capacity_type == wk.CAPACITY_TYPE_SPOT))
+            # cut line: queued capacity exists in the cloud, nothing recorded
+            self._crash("after_qr_create", name)
             self._qr_cache.invalidate(name)  # … and anything raced in since
         if qr.state in (QR_SUSPENDED, QR_FAILED):
             raise InsufficientCapacityError(
@@ -337,9 +420,15 @@ class InstanceProvider:
             return {}
 
         # claims FIRST (live/informer read): their name-set is the
-        # freshness fingerprint the pool snapshot is validated against
-        claims = await self.kube.list(
-            NodeClaim, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+        # freshness fingerprint the pool snapshot is validated against.
+        # Deleting members are excluded: a claim in finalize must not
+        # reserve an index in the assignment order — its pool can already
+        # be gone server-side while the finalizer drains, and a
+        # replacement member racing that window would be pushed past the
+        # freed index forever (the index is sticky once stamped).
+        claims = [c for c in await self.kube.list(
+                      NodeClaim, labels={wk.TPU_SLICE_GROUP_LABEL: group})
+                  if c.metadata.deletion_timestamp is None]
         pools = await self._pools_snapshot(
             group, frozenset(c.metadata.name for c in claims))
         used: dict[int, str] = {}          # stamped index -> pool name
@@ -560,6 +649,25 @@ class InstanceProvider:
         )
 
     # ------------------------------------------------------------- delete
+    async def delete_queued(self, name: str) -> None:
+        """Fenced queued-resource teardown (NotFound is success). The ONE
+        path every QR delete goes through — delete() and the recovery
+        pass's orphan reap alike — so the fencing check and the cache
+        invalidation can never be bypassed."""
+        if self.queued is None:
+            return
+        try:
+            self._fence_check()
+            await self.queued.delete(name)
+        except APIError as e:
+            if not e.not_found:
+                raise
+        finally:
+            # unconditionally: success AND failure paths must both drop
+            # any cached QR view — a cached entry must never make a
+            # retried delete() skip the queued-resource cleanup
+            self._qr_cache.invalidate(name)
+
     async def delete(self, name: str) -> None:
         """Get-first delete: skip if already Deleting, map NotFound →
         NodeClaimNotFoundError (armutils.go:42-76).
@@ -569,17 +677,7 @@ class InstanceProvider:
         stockout ladder until launch liveness reaps the claim — and keying
         the cleanup off a successful pool get would leak that queued
         resource forever (found by the stuck-queue chaos profile)."""
-        if self.queued is not None:
-            try:
-                await self.queued.delete(name)
-            except APIError as e:
-                if not e.not_found:
-                    raise
-            finally:
-                # unconditionally: success AND failure paths must both drop
-                # any cached QR view — a cached entry must never make a
-                # retried delete() skip the queued-resource cleanup
-                self._qr_cache.invalidate(name)
+        await self.delete_queued(name)
         # LIVE read, deliberately around the cache: delete decisions (skip
         # if already Deleting) must never ride a stale cached status.
         try:
@@ -597,8 +695,11 @@ class InstanceProvider:
             log.info("nodepool %s already deleting, skipping", name)
             return
         try:
+            self._fence_check()
             op = await self.nodepools.begin_delete(name)
             self._pool_cache.invalidate(name)  # state transition: Deleting
+            # cut line: delete LRO issued (QR already cleaned up), unpolled
+            self._crash("mid_delete_after_pool_delete", name)
             await poll_until_done(op)
             # again after the poll: a read begun mid-delete may have cached
             # the dying pool between the first invalidation and completion

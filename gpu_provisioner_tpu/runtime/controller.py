@@ -77,6 +77,13 @@ class Controller:
       the item outright would wedge the object until an unrelated watch
       event; the slow-poll keeps liveness/GC able to converge it while
       staying O(1) calls per max_delay window. 0 disables the bound.
+    - ``fence``: leadership fencing token (duck-typed ``valid()`` —
+      runtime/leaderelection.FencingToken). When set and invalid, workers
+      DROP dequeued items instead of reconciling: this process lost the
+      lease, the new leader's watch replay owns every object now, and a
+      requeue would only keep a dying incarnation's queue warm. The
+      instance provider carries its own fence check for reconciles already
+      in flight when leadership is lost.
     """
 
     def __init__(self, name: str, reconciler: Reconciler, max_concurrent: int = 10,
@@ -87,11 +94,15 @@ class Controller:
         self.max_concurrent = max_concurrent
         self.reconcile_timeout = reconcile_timeout
         self.max_retries = max_retries
+        # assigned by the registry (build_controllers) / operator boot path
+        # once leadership is won — construction predates the election
+        self.fence = None
         self.queue = RateLimitingQueue()
         self.sources: list[_Source] = []
         self.singleton = False
         self.timeouts_total = 0
         self.retries_exhausted_total = 0
+        self.fenced_total = 0
         self._metrics_hook: Optional[Callable[[str, float, Optional[str]], None]] = None
         self._exhausted_hook: Optional[Callable[[str, Request, int], Awaitable[None]]] = None
 
@@ -156,6 +167,11 @@ class Controller:
     async def _worker(self) -> None:
         while True:
             req = await self.queue.get()
+            if self.fence is not None and not self.fence.valid():
+                # Deposed leader: single-writer discipline beats progress.
+                self.fenced_total += 1
+                await self.queue.done(req)
+                continue
             start = time.monotonic()
             err: Optional[str] = None
             try:

@@ -7,6 +7,17 @@ another holder's lease has expired, take it over (bumping
 exits so the replica restarts into candidacy; ``on_lost`` defaults to
 setting an event the operator treats as a stop signal.
 
+Fencing: a replica that loses the lease mid-reconcile must not keep mutating
+the cloud while the new leader acts. ``fence()`` captures the leadership
+generation at acquisition as a :class:`FencingToken`; reconcile loops and
+the instance provider check it before cloud mutations. The token is local —
+the cloud APIs cannot validate it server-side — which is sufficient ONLY
+because the renew loop anchors its give-up deadline at the *last successful
+renew*: this replica stops acting as leader no later than the instant the
+lease becomes legally stealable, so a correctly-fenced deposed leader and a
+new leader never overlap (clock skew between replicas aside, which the
+observed-staleness expiry check below also bounds).
+
 Defaults mirror client-go: 15s lease, 10s renew deadline, 2s retry.
 """
 
@@ -18,6 +29,7 @@ import math
 import os
 import socket
 import uuid
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..apis.core import Lease, LeaseSpec
@@ -34,6 +46,31 @@ RETRY_INTERVAL = 2.0
 
 def default_identity() -> str:
     return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+class FencedError(Exception):
+    """A mutation was attempted under a fencing token that no longer matches
+    the live leadership generation — the caller is a deposed leader."""
+
+
+@dataclass(frozen=True)
+class FencingToken:
+    """Leadership generation captured at acquisition. ``valid()`` is a pure
+    local check (no apiserver round-trip) — see the module docstring for why
+    that is sufficient when paired with the renew-deadline anchoring."""
+
+    elector: "LeaderElector"
+    generation: int
+
+    def valid(self) -> bool:
+        return (self.elector.leading.is_set()
+                and self.elector.generation == self.generation)
+
+    def check(self) -> None:
+        if not self.valid():
+            raise FencedError(
+                f"fencing token generation {self.generation} is stale "
+                f"(holder {self.elector.identity} no longer leads)")
 
 
 class LeaderElector:
@@ -53,13 +90,29 @@ class LeaderElector:
         self.retry_interval = retry_interval
         self.on_lost = on_lost
         self.leading = asyncio.Event()
+        # Bumped on every acquisition; FencingTokens capture it so a token
+        # from a previous term can never validate again, even after this
+        # replica re-wins the lease.
+        self.generation = 0
         self._task: Optional[asyncio.Task] = None
+        self._last_renew: float = 0.0
+        # (holder, renew_time) last observed on a foreign lease + the local
+        # monotonic time of that observation — the clock-skew guard.
+        self._observed: Optional[tuple[tuple, float]] = None
+
+    def fence(self) -> FencingToken:
+        """Token for the CURRENT term; call after ``run_until_leading``."""
+        if not self.leading.is_set():
+            raise RuntimeError("fence() requires leadership")
+        return FencingToken(self, self.generation)
 
     async def run_until_leading(self) -> None:
         """Block until this replica holds the lease, then keep renewing in
         the background."""
         while not await self._try_acquire():
             await asyncio.sleep(self.retry_interval)
+        self._last_renew = asyncio.get_event_loop().time()
+        self.generation += 1
         self.leading.set()
         log.info("leader election won", extra={"identity": self.identity,
                                                "lease": self.lease_name})
@@ -74,8 +127,8 @@ class LeaderElector:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        self.leading.clear()  # before the release: no fenced-valid window
         await self._release()
-        self.leading.clear()
 
     # --- internals ---------------------------------------------------------
 
@@ -83,7 +136,22 @@ class LeaderElector:
         if lease.spec.renew_time is None:
             return True
         age = (now() - lease.spec.renew_time).total_seconds()
-        return age > lease.spec.lease_duration_seconds
+        if age > lease.spec.lease_duration_seconds:
+            return True
+        # Clock-skew tolerance: a renew_time AHEAD of our clock (negative
+        # age) must not extend the holder's term past what we can verify —
+        # otherwise a skewed holder wedges candidacy for the skew + the
+        # lease duration. Judge staleness by how long WE have observed this
+        # (holder, renew_time) pair unchanged on our own monotonic clock
+        # (client-go's observedTime): a live holder bumps renew_time every
+        # renew_interval < lease_duration, so a pair that survives a full
+        # lease_duration of local time is dead whatever its clock claims.
+        key = (lease.spec.holder_identity, lease.spec.renew_time)
+        mono = asyncio.get_event_loop().time()
+        if self._observed is None or self._observed[0] != key:
+            self._observed = (key, mono)
+            return False
+        return mono - self._observed[1] > lease.spec.lease_duration_seconds
 
     async def _try_acquire(self) -> bool:
         try:
@@ -127,23 +195,39 @@ class LeaderElector:
                 return False
             lease.spec.renew_time = now()
             await self.client.update(lease)
+            self._last_renew = asyncio.get_event_loop().time()
             return True
         except (ConflictError, NotFoundError):
             return False
 
     async def _renew_loop(self) -> None:
+        loop = asyncio.get_event_loop()
         while True:
             await asyncio.sleep(self.renew_interval)
-            deadline = asyncio.get_event_loop().time() + self.lease_duration
+            # The give-up deadline is anchored at the LAST SUCCESSFUL renew,
+            # not the start of this retry loop: the lease becomes legally
+            # stealable lease_duration after its renew_time, and the last
+            # renew was renew_interval ago — granting ourselves a fresh
+            # lease_duration from now would keep this replica acting as
+            # leader for up to renew_interval AFTER a rival may already hold
+            # the lease (the dual-writer window fencing exists to close).
+            deadline = self._last_renew + self.lease_duration
             renewed = False
-            while asyncio.get_event_loop().time() < deadline:
-                if await self._renew():
-                    renewed = True
+            while (remaining := deadline - loop.time()) > 0:
+                try:
+                    # a hung renew call must not let us overshoot the
+                    # deadline either — bound it by the remaining budget
+                    if await asyncio.wait_for(self._renew(),
+                                              timeout=remaining):
+                        renewed = True
+                        break
+                except asyncio.TimeoutError:
                     break
-                await asyncio.sleep(self.retry_interval)
+                await asyncio.sleep(min(self.retry_interval,
+                                        max(0.0, deadline - loop.time())))
             if not renewed:
                 log.error("leadership lost", extra={"identity": self.identity})
-                self.leading.clear()
+                self.leading.clear()  # invalidates every outstanding fence
                 if self.on_lost is not None:
                     self.on_lost()
                 return

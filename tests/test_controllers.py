@@ -17,7 +17,9 @@ from gpu_provisioner_tpu.apis.meta import ObjectMeta
 from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
 from gpu_provisioner_tpu.fake import make_nodeclaim
 from gpu_provisioner_tpu.providers.gcp import APIError
-from gpu_provisioner_tpu.runtime import EvictionBlockedError, NotFoundError
+from gpu_provisioner_tpu.runtime import (
+    EvictionBlockedError, InMemoryClient, NotFoundError,
+)
 
 from .conftest import async_test
 
@@ -520,3 +522,74 @@ async def test_grace_deadline_escalates_past_blocked_eviction():
         assert await env.client.list(Node) == []
         got = await env.client.get(Pod, "inference", "default")
         assert got.metadata.name == "inference"
+
+
+@async_test
+async def test_eviction_queue_stop_clears_parked_state_no_timer_leak():
+    """Crash-restart satellite: stop() while pods are parked in backoff must
+    cancel every timer task, clear the dedup/failure maps (no ghost entries
+    blocking a future enqueue), and leave nothing that can resurrect keys
+    into a later queue."""
+    from gpu_provisioner_tpu.controllers.termination import EvictionQueue
+
+    client = InMemoryClient()
+    pod = _workload_pod()
+    await client.create(pod)
+    await client.create(_pdb())  # blocks eviction → backoff timers
+    q = EvictionQueue(client, qps=100)
+    q.start()
+    stored = await client.get(Pod, "inference", "default")
+    q.enqueue(stored)
+    key = (stored.metadata.namespace, stored.metadata.name,
+           stored.metadata.uid)
+    deadline = asyncio.get_event_loop().time() + 5
+    while q._failures.get(key, 0) < 2:  # parked in a backoff timer
+        assert asyncio.get_event_loop().time() < deadline, "never blocked"
+        await asyncio.sleep(0.02)
+
+    await q.stop()
+    assert not q._timers, "backoff timer task leaked past stop()"
+    assert not q._pods and not q._failures, "dedup/failure ghosts survived"
+    assert q._q.empty()
+    # a cancelled timer firing late must not resurrect the key
+    await asyncio.sleep(0.5)
+    assert q._q.empty() and not q._pods
+
+
+@async_test
+async def test_eviction_queue_restart_redrains_parked_pods():
+    """A restarted queue re-discovers and drains pods the dead incarnation
+    had parked in backoff — stop() left no dedup entry to swallow the
+    re-enqueue."""
+    from gpu_provisioner_tpu.controllers.termination import EvictionQueue
+
+    client = InMemoryClient()
+    await client.create(_workload_pod())
+    await client.create(_pdb())
+    q = EvictionQueue(client, qps=100)
+    q.start()
+    stored = await client.get(Pod, "inference", "default")
+    q.enqueue(stored)
+    key = (stored.metadata.namespace, stored.metadata.name,
+           stored.metadata.uid)
+    deadline = asyncio.get_event_loop().time() + 5
+    while q._failures.get(key, 0) < 1:
+        assert asyncio.get_event_loop().time() < deadline
+        await asyncio.sleep(0.02)
+    await q.stop()
+
+    # restart: the blockage lifts, a fresh drain pass re-enqueues the pod
+    await client.delete(PodDisruptionBudget, "inf-pdb", "default")
+    q.start()
+    q.enqueue(stored)
+    assert key in q._pods, "stale dedup entry swallowed the re-enqueue"
+    deadline = asyncio.get_event_loop().time() + 5
+    while True:
+        try:
+            await client.get(Pod, "inference", "default")
+        except NotFoundError:
+            break  # evicted by the restarted queue
+        assert asyncio.get_event_loop().time() < deadline, \
+            "restarted queue never drained the parked pod"
+        await asyncio.sleep(0.02)
+    await q.stop()

@@ -12,7 +12,7 @@ from gpu_provisioner_tpu.cloudprovider.errors import (
     CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
 )
 from gpu_provisioner_tpu.fake import FakeCloud, make_nodeclaim
-from gpu_provisioner_tpu.providers.gcp import APIError, NP_STOPPING
+from gpu_provisioner_tpu.providers.gcp import APIError, NP_ERROR, NP_STOPPING
 from gpu_provisioner_tpu.providers.instance import (
     PROVISIONING_MODE_ANNOTATION, InstanceProvider, ProviderConfig,
     STATE_SUCCEEDED, nodepool_name_valid, parse_nodepool_from_provider_id,
@@ -95,6 +95,64 @@ async def test_create_tolerates_inflight_operation():
     cloud.nodepools.fail("begin_create", APIError("in progress", code=409))
     inst = await provider.create(make_nodeclaim())
     assert inst.state == STATE_SUCCEEDED
+
+
+@async_test
+async def test_conflict_fall_through_surfaces_degraded_pool():
+    """Satellite fix for the blind wait: a conflicting create whose pool
+    sits in ERROR is a terminal CreateError NOW — not a full node-wait
+    against a pool that will never produce nodes."""
+    kube, cloud, provider = setup()
+    from gpu_provisioner_tpu.catalog import lookup
+    op = await cloud.nodepools.begin_create(
+        provider._new_nodepool_object(make_nodeclaim(), lookup("tpu-v5e-8"),
+                                      wk.CAPACITY_TYPE_ON_DEMAND))
+    await op.result()
+    # the adopted create's pool lands in ERROR (op-error carcass shape)
+    cloud.nodepools.pools["ws0"].status = NP_ERROR
+    cloud.nodepools.pools["ws0"].status_message = "instance exhausted"
+    cloud.nodepools.fail("begin_create", APIError("in progress", code=409))
+    calls_before = cloud.nodepools.calls.get("get", 0)
+    with pytest.raises(CreateError) as e:
+        await provider.create(make_nodeclaim())
+    assert e.value.reason == "DegradedPool"
+    assert "instance exhausted" in str(e.value)
+    # one state poll, not a node-wait's worth of them
+    assert cloud.nodepools.calls.get("get", 0) - calls_before <= 2
+
+
+@async_test
+async def test_conflict_fall_through_requeues_on_stuck_provisioning():
+    """Adopting an in-flight create that never settles gives the workqueue
+    a retryable CreateError after the wait budget — never a silent wedge."""
+    kube, cloud, provider = setup()
+    cloud.create_latency = 999  # the other incarnation's LRO never finishes
+    from gpu_provisioner_tpu.catalog import lookup
+    await cloud.nodepools.begin_create(
+        provider._new_nodepool_object(make_nodeclaim(), lookup("tpu-v5e-8"),
+                                      wk.CAPACITY_TYPE_ON_DEMAND))
+    with pytest.raises(CreateError) as e:
+        await provider.create(make_nodeclaim())  # real 409 from the fake
+    assert e.value.reason == "CreateInProgress"
+
+
+@async_test
+async def test_fake_begin_create_conflicts_on_live_pool_replaces_error():
+    """GKE 409s a live pool; only an ERROR carcass is re-creatable in place
+    (the op-error replace-never-duplicate contract)."""
+    kube, cloud, provider = setup()
+    from gpu_provisioner_tpu.catalog import lookup
+    pool_obj = provider._new_nodepool_object(
+        make_nodeclaim(), lookup("tpu-v5e-8"), wk.CAPACITY_TYPE_ON_DEMAND)
+    op = await cloud.nodepools.begin_create(pool_obj)
+    await op.result()  # RUNNING
+    with pytest.raises(APIError) as e:
+        await cloud.nodepools.begin_create(pool_obj)
+    assert e.value.conflict
+    cloud.nodepools.pools["ws0"].status = NP_ERROR
+    op2 = await cloud.nodepools.begin_create(pool_obj)  # replace carcass
+    await op2.result()
+    assert cloud.nodepools.pools["ws0"].status == "RUNNING"
 
 
 @async_test

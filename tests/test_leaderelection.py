@@ -1,15 +1,52 @@
-"""Lease leader-election tests (reference: operator.go:157-164 via client-go)."""
+"""Lease leader-election tests (reference: operator.go:157-164 via client-go),
+plus the crash-restart PR's takeover-race and fencing coverage: expired-lease
+steal under conflict contention, voluntary-release handoff latency,
+clock-skew tolerance, the renew-deadline anchoring that makes local fencing
+sound, and FencingToken invalidation."""
 
 import asyncio
+from datetime import timedelta
 
-from gpu_provisioner_tpu.apis.core import Lease
-from gpu_provisioner_tpu.runtime import InMemoryClient
-from gpu_provisioner_tpu.runtime.leaderelection import LeaderElector
+import pytest
+
+from gpu_provisioner_tpu.apis.core import Lease, LeaseSpec
+from gpu_provisioner_tpu.apis.meta import ObjectMeta
+from gpu_provisioner_tpu.apis.serde import now
+from gpu_provisioner_tpu.runtime import ConflictError, InMemoryClient
+from gpu_provisioner_tpu.runtime.leaderelection import (
+    FencedError, LeaderElector,
+)
 
 from .conftest import async_test
 
 # second-resolution Lease timestamps (metav1.Time) bound how fast these run
 FAST = dict(lease_duration=2.0, renew_interval=0.4, retry_interval=0.1)
+
+
+class _Gate:
+    """Per-elector client over a shared store whose Lease traffic can be
+    blackholed — simulates THIS replica losing the apiserver while rivals
+    (their own clients) keep working."""
+
+    def __init__(self, store):
+        self.inner = InMemoryClient(store)
+        self.gated = False
+
+    def _check(self, cls):
+        if self.gated and cls is Lease:
+            raise ConflictError("gated: lease traffic blackholed")
+
+    async def get(self, cls, name, namespace=""):
+        self._check(cls)
+        return await self.inner.get(cls, name, namespace)
+
+    async def create(self, obj):
+        self._check(type(obj))
+        return await self.inner.create(obj)
+
+    async def update(self, obj):
+        self._check(type(obj))
+        return await self.inner.update(obj)
 
 
 @async_test
@@ -65,6 +102,144 @@ async def test_expired_lease_is_stolen():
     assert lease.spec.holder_identity == "b"
     assert lease.spec.lease_transitions == 1
     await b.stop()
+
+
+@async_test
+async def test_renew_deadline_anchored_at_last_renew():
+    """Satellite fix: the give-up deadline runs from the LAST SUCCESSFUL
+    renew, not the start of the retry loop — the old code granted itself a
+    fresh lease_duration measured from renew_interval AFTER the last renew,
+    so a rival could legally steal the lease while this replica still
+    believed it led (the dual-writer window). Assert no overlap: A declares
+    loss no later than B acquires."""
+    client = InMemoryClient()
+    gate = _Gate(client.store)
+    loop = asyncio.get_event_loop()
+    a_lost = {}
+    a = LeaderElector(gate, identity="a",
+                      on_lost=lambda: a_lost.setdefault("t", loop.time()),
+                      **FAST)
+    await a.run_until_leading()
+    gate.gated = True  # apiserver gone for A; last renew ≈ acquisition
+    b = LeaderElector(client, identity="b", **FAST)
+    b_task = asyncio.create_task(b.run_until_leading())
+    await asyncio.wait_for(b_task, 15)
+    b_acquired = loop.time()
+    await asyncio.sleep(0.3)  # let A's loop reach its verdict if it hasn't
+    assert "t" in a_lost, "A never declared loss"
+    assert not a.leading.is_set()
+    # single-writer: A stopped leading before (or within jitter of) B's win
+    assert a_lost["t"] <= b_acquired + 0.15, \
+        f"dual-leader window: A lost at {a_lost['t']}, B won at {b_acquired}"
+    await b.stop()
+
+
+@async_test
+async def test_expired_steal_race_single_winner_under_conflict():
+    """Two candidates race an expired foreign lease: optimistic-concurrency
+    conflicts must leave EXACTLY one holder and push the loser back into
+    candidacy (not an error, not a second leader)."""
+    client = InMemoryClient()
+    await client.create(Lease(
+        metadata=ObjectMeta(name="tpu-provisioner", namespace="default"),
+        spec=LeaseSpec(holder_identity="dead", lease_duration_seconds=2,
+                       renew_time=now() - timedelta(seconds=60))))
+    a = LeaderElector(client, identity="a", **FAST)
+    b = LeaderElector(client, identity="b", **FAST)
+    ta = asyncio.create_task(a.run_until_leading())
+    tb = asyncio.create_task(b.run_until_leading())
+    done, pending = await asyncio.wait((ta, tb), timeout=10,
+                                       return_when=asyncio.FIRST_COMPLETED)
+    assert done, "neither candidate stole the expired lease"
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    winner = lease.spec.holder_identity
+    assert winner in ("a", "b")
+    assert a.leading.is_set() != b.leading.is_set(), "two leaders"
+    assert lease.spec.lease_transitions == 1
+    for t in pending:
+        t.cancel()
+    await (a if winner == "a" else b).stop()
+
+
+@async_test
+async def test_voluntary_release_hands_over_within_retry_interval():
+    """A clean shutdown releases the lease; the next candidate must win at
+    its retry cadence — never by waiting out the full lease duration."""
+    client = InMemoryClient()
+    a = LeaderElector(client, identity="a", **FAST)
+    b = LeaderElector(client, identity="b", **FAST)
+    await a.run_until_leading()
+    b_task = asyncio.create_task(b.run_until_leading())
+    await asyncio.sleep(0.3)  # b is parked in candidacy
+    t0 = asyncio.get_event_loop().time()
+    await a.stop()
+    await asyncio.wait_for(b_task, 5)
+    waited = asyncio.get_event_loop().time() - t0
+    assert waited < FAST["lease_duration"] / 2, \
+        f"handoff took {waited:.2f}s — waited out the lease instead of " \
+        "taking the release"
+    await b.stop()
+
+
+@async_test
+async def test_future_renew_time_does_not_wedge_candidacy():
+    """Clock skew: a holder whose renew_time is AHEAD of our clock must not
+    extend its term by the skew — staleness is judged by how long WE have
+    observed the (holder, renew_time) pair unchanged."""
+    client = InMemoryClient()
+    await client.create(Lease(
+        metadata=ObjectMeta(name="tpu-provisioner", namespace="default"),
+        spec=LeaseSpec(holder_identity="skewed", lease_duration_seconds=2,
+                       renew_time=now() + timedelta(seconds=30))))
+    b = LeaderElector(client, identity="b", **FAST)
+    t0 = asyncio.get_event_loop().time()
+    await asyncio.wait_for(b.run_until_leading(), 10)
+    waited = asyncio.get_event_loop().time() - t0
+    # observed-staleness expiry: ~lease_duration, NOT skew + lease_duration
+    assert waited < FAST["lease_duration"] + 1.5, \
+        f"candidacy wedged {waited:.2f}s behind a future renew_time"
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    assert lease.spec.holder_identity == "b"
+    await b.stop()
+
+
+@async_test
+async def test_fencing_token_tracks_generation_and_loss():
+    """fence() captures the leadership generation: invalid the instant the
+    lease is lost, and NEVER valid again — even after the same replica
+    re-wins (a new term mints a new generation)."""
+    client = InMemoryClient()
+    a = LeaderElector(client, identity="a", **FAST)
+    await a.run_until_leading()
+    tok = a.fence()
+    assert tok.valid()
+    tok.check()  # no raise while leading
+
+    # usurper rewrites the lease; A notices at its renew deadline
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    lease.spec.holder_identity = "usurper"
+    await client.update(lease)
+    deadline = asyncio.get_event_loop().time() + 10
+    while a.leading.is_set():
+        assert asyncio.get_event_loop().time() < deadline
+        await asyncio.sleep(0.05)
+    assert not tok.valid()
+    with pytest.raises(FencedError):
+        tok.check()
+
+    # the usurper dies; A re-wins — the OLD token must stay fenced
+    lease = await client.get(Lease, "tpu-provisioner", "default")
+    lease.spec.holder_identity = ""
+    lease.spec.renew_time = None
+    await client.update(lease)
+    await asyncio.wait_for(a.run_until_leading(), 10)
+    assert a.leading.is_set()
+    assert not tok.valid(), "a stale-term token validated after re-election"
+    tok2 = a.fence()
+    assert tok2.valid() and tok2.generation > tok.generation
+    await a.stop()
+    with pytest.raises(RuntimeError):
+        a.fence()  # no leadership, no token
 
 
 @async_test

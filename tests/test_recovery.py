@@ -1,0 +1,372 @@
+"""Crash-restart recovery soaks: kill the operator at a named cut line,
+boot a fresh incarnation against the SAME kube store + fake cloud, and
+prove convergence with zero leaked cloud resources.
+
+Three layers under test (docs/FAILURE_MODES.md "Crash & restart taxonomy"):
+
+1. **Crash points** (`chaos.CrashPoints`): SimulatedCrash raised through the
+   operator at the cut lines that strand the most interesting state.
+2. **Restart harness** (`envtest.RestartableEnv`): incarnation teardown
+   cancels every operator task and drops all in-memory caches; cloud + kube
+   state — including in-flight LROs the fake keeps driving server-side —
+   persist.
+3. **Recovery mechanisms**: idempotent create + conflict adoption, the
+   startup resync/orphan-adoption pass (controllers/recovery.py), and
+   fenced leader failover (runtime/leaderelection.py).
+
+The heavy matrix and failover soaks are marked ``slow`` (excluded from the
+tier-1 gate, run via ``make recover``); the smoke is also marked ``chaos``
+so ``make chaos`` exercises one restart profile.
+"""
+
+import asyncio
+import os
+from datetime import timedelta
+
+import pytest
+
+from gpu_provisioner_tpu import chaos
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Lease, Node, Pod, PodSpec
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import ObjectMeta
+from gpu_provisioner_tpu.apis.serde import now
+from gpu_provisioner_tpu.controllers.metrics import (
+    RECOVERY_ADOPTED, RECOVERY_REAPED, RECOVERY_RESUMED,
+)
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions, RestartableEnv
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.providers.gcp import (
+    NodePool, NodePoolConfig, NP_RUNNING, QueuedResource,
+)
+from gpu_provisioner_tpu.providers.instance import (
+    PROVISIONING_MODE_ANNOTATION, ts_label,
+)
+from gpu_provisioner_tpu.runtime import InMemoryClient
+from gpu_provisioner_tpu.runtime.leaderelection import (
+    FencedError, LeaderElector,
+)
+
+from .conftest import async_test
+
+pytestmark = pytest.mark.recovery
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+QUEUED = {PROVISIONING_MODE_ANNOTATION: "queued"}
+
+
+def _opts(**kw) -> EnvtestOptions:
+    """Envtest tuned like the chaos soaks: fast GC, short liveness budgets."""
+    kw.setdefault("gc_interval", 0.1)
+    kw.setdefault("leak_grace", 0.1)
+    opts = EnvtestOptions(**kw)
+    opts.lifecycle.launch_timeout = 20.0
+    opts.lifecycle.registration_timeout = 20.0
+    return opts
+
+
+async def _assert_no_leaks(renv: RestartableEnv, pools: set[str],
+                           qrs: set[str] = frozenset(),
+                           timeout: float = 10.0) -> None:
+    """Settle loop over the leak invariant: the fake cloud's pools and
+    queued resources exactly match the surviving claims."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        have_pools = set(renv.cloud.nodepools.pools)
+        have_qrs = set(renv.cloud.queuedresources.resources)
+        nodes = await renv.client.list(Node)
+        node_pools = {n.metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
+                      for n in nodes}
+        if (have_pools == pools and have_qrs == qrs
+                and node_pools <= pools | {None}):
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"leak invariant violated: pools={sorted(have_pools)} "
+                f"(want {sorted(pools)}), qrs={sorted(have_qrs)} "
+                f"(want {sorted(qrs)}), orphan-node-pools="
+                f"{sorted((node_pools - pools) - {None}, key=str)}")
+        await asyncio.sleep(0.05)
+
+
+# ------------------------------------------------------------------ smoke
+
+@pytest.mark.chaos
+@async_test
+async def test_crash_restart_smoke():
+    """The one-restart profile `make chaos` runs: die right after the create
+    LRO is issued, restart, adopt the in-flight create, converge, zero
+    leaks — and the recovery pass counts the adoption."""
+    adopted0 = RECOVERY_ADOPTED.labels("pool")._value.get()
+    crashes = chaos.CrashPoints(at="after_pool_begin_create", seed=SEED)
+    renv = RestartableEnv(_opts(crashes=crashes))
+    await renv.start()
+    try:
+        await renv.client.create(make_nodeclaim("sm0"))
+        await asyncio.wait_for(crashes.crashed.wait(), 15)
+        assert crashes.fired["after_pool_begin_create"] == 1
+        assert crashes.last == ("after_pool_begin_create", "sm0")
+
+        await renv.restart()
+        nc = await renv.wait_ready("sm0", timeout=25)
+        assert nc.status.provider_id
+        await _assert_no_leaks(renv, {"sm0"})
+        assert renv.incarnations == 2
+        assert RECOVERY_ADOPTED.labels("pool")._value.get() > adopted0, \
+            "startup resync pass never counted the adoption"
+    finally:
+        await renv.crash()
+
+
+# ----------------------------------------------------------- crash matrix
+
+# (scenario, crash point, queued-mode) — every crash point crossed with the
+# lifecycle phase it can strand (a queued-mode delete exercises the same
+# mid-delete cut lines plus the QR cleanup that precedes them).
+MATRIX = [
+    ("mid-create", "after_pool_begin_create", False),
+    ("mid-create", "before_lro_done", False),
+    ("queued", "after_qr_create", True),
+    ("queued", "after_pool_begin_create", True),
+    ("queued", "before_lro_done", True),
+    ("mid-delete", "mid_delete_after_pool_delete", False),
+    ("mid-delete", "mid_drain", False),
+    ("mid-delete", "mid_delete_after_pool_delete", True),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario,point,queued", MATRIX)
+@async_test
+async def test_crash_restart_matrix(scenario, point, queued):
+    """For every crash point × scenario: a restarted incarnation converges
+    the claim (Ready, or fully deleted for mid-delete) with zero leaked
+    pools/queued resources."""
+    crashes = chaos.CrashPoints(seed=SEED)
+    # queued scenarios slow the QR ladder so the restart genuinely lands
+    # mid-ladder (the wall-clock ladder would otherwise finish during the
+    # restart gap and hide the resume path)
+    opts = _opts(crashes=crashes,
+                 qr_step_latency=0.3 if queued else 0.02)
+    renv = RestartableEnv(opts)
+    await renv.start()
+    try:
+        ann = QUEUED if queued else None
+        if scenario == "mid-delete":
+            await renv.client.create(make_nodeclaim("cr0", annotations=ann))
+            await renv.wait_ready("cr0", timeout=25)
+            if point == "mid_drain":
+                # a pod on the node makes the drain non-trivial
+                await renv.client.create(Pod(
+                    metadata=ObjectMeta(name="payload", namespace="default"),
+                    spec=PodSpec(node_name="gke-kaito-cr0-w0")))
+            crashes.arm(point)
+            await renv.client.delete(NodeClaim, "cr0")
+        else:
+            crashes.arm(point)
+            await renv.client.create(make_nodeclaim("cr0", annotations=ann))
+
+        await asyncio.wait_for(crashes.crashed.wait(), 20)
+        assert crashes.fired[point] == 1, crashes.fired
+
+        resumed0 = RECOVERY_RESUMED.labels("qr")._value.get()
+        await renv.restart()
+
+        if scenario == "mid-delete":
+            await renv.wait_gone("cr0", timeout=25)
+            await _assert_no_leaks(renv, set())
+        else:
+            await renv.wait_ready("cr0", timeout=30)
+            await _assert_no_leaks(renv, {"cr0"},
+                                   qrs={"cr0"} if queued else frozenset())
+            if point == "after_qr_create":
+                assert RECOVERY_RESUMED.labels("qr")._value.get() > resumed0, \
+                    "mid-ladder queued resource not counted as resumed"
+    finally:
+        await renv.crash()
+
+
+# ------------------------------------------------- startup resync / orphans
+
+@async_test
+async def test_recovery_pass_reaps_orphans_at_boot():
+    """Cloud state with no NodeClaim behind it is reaped by the startup
+    resync pass immediately — not a GC interval later (GC is disabled here
+    to prove attribution)."""
+    reaped0 = sum(RECOVERY_REAPED.labels(k)._value.get()
+                  for k in ("pool", "qr"))
+    renv = RestartableEnv(_opts(gc_interval=600.0))
+    # a dead incarnation's leftovers: an old claimless pool + queued resource
+    pool = NodePool(
+        name="orphan",
+        config=NodePoolConfig(machine_type="ct5lp-hightpu-4t", labels={
+            wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,
+            wk.KAITO_CREATION_TIMESTAMP_LABEL:
+                ts_label(now() - timedelta(seconds=120)),
+        }),
+        initial_node_count=1, status=NP_RUNNING)
+    renv.cloud.nodepools.pools["orphan"] = pool
+    renv.cloud.queuedresources.resources["orphanq"] = QueuedResource(
+        name="orphanq")
+    await renv.start()
+    try:
+        deadline = asyncio.get_event_loop().time() + 10
+        while (renv.cloud.nodepools.pools
+               or renv.cloud.queuedresources.resources):
+            assert asyncio.get_event_loop().time() < deadline, (
+                f"recovery never reaped: pools="
+                f"{list(renv.cloud.nodepools.pools)} "
+                f"qrs={list(renv.cloud.queuedresources.resources)}")
+            await asyncio.sleep(0.05)
+        reaped = sum(RECOVERY_REAPED.labels(k)._value.get()
+                     for k in ("pool", "qr"))
+        assert reaped >= reaped0 + 2
+    finally:
+        await renv.crash()
+
+
+@async_test
+async def test_fake_cloud_drives_lros_server_side():
+    """The restart substrate itself: an LRO whose poller died still
+    completes — a stranded create turns RUNNING and joins nodes, a stranded
+    delete removes the pool and its nodes."""
+    from gpu_provisioner_tpu.fake import FakeCloud
+
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=0.05, delete_latency=0.05)
+    pool = NodePool(name="lro0", config=NodePoolConfig(
+        machine_type="ct5lp-hightpu-4t",
+        labels={wk.INSTANCE_TYPE_LABEL: "tpu-v5e-8"}))
+    await cloud.nodepools.begin_create(pool)  # op dropped: poller "died"
+    assert cloud.nodepools.pools["lro0"].status == "PROVISIONING"
+    await asyncio.sleep(0.06)
+    got = await cloud.nodepools.get("lro0")   # any API touch settles
+    assert got.status == NP_RUNNING
+    assert len(await kube.list(Node)) == 1, "kubelets joined without a poller"
+
+    await cloud.nodepools.begin_delete("lro0")  # op dropped again
+    await asyncio.sleep(0.06)
+    pools = await cloud.nodepools.list()
+    assert pools == [] and await kube.list(Node) == []
+
+
+# -------------------------------------------------------- fenced failover
+
+FAST = dict(lease_duration=2.0, renew_interval=0.4, retry_interval=0.1)
+
+
+class _GatedClient:
+    """Client for the doomed elector: when ``gated``, Lease traffic fails —
+    the zombie's renew loop sees a dead apiserver while its reconcile tasks
+    keep running (the half-dead process fencing exists for)."""
+
+    def __init__(self, store):
+        self.inner = InMemoryClient(store)
+        self.gated = False
+
+    def _check(self, cls):
+        if self.gated and cls is Lease:
+            from gpu_provisioner_tpu.runtime.client import ConflictError
+            raise ConflictError("gated: lease traffic blackholed")
+
+    async def get(self, cls, name, namespace=""):
+        self._check(cls)
+        return await self.inner.get(cls, name, namespace)
+
+    async def create(self, obj):
+        self._check(type(obj))
+        return await self.inner.create(obj)
+
+    async def update(self, obj):
+        self._check(type(obj))
+        return await self.inner.update(obj)
+
+
+def _mutations(provider) -> dict:
+    """Snapshot of the cloud-MUTATING endpoint counters for one provider
+    (one incarnation) — the single-writer assertion currency."""
+    out = {f"np.{k}": v for k, v in provider.nodepools.calls.items()
+           if k in ("begin_create", "begin_delete")}
+    if provider.queued is not None:
+        out.update({f"qr.{k}": v for k, v in provider.queued.calls.items()
+                    if k in ("create", "delete")})
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", list(chaos.CRASH_POINTS))
+@async_test
+async def test_failover_soak_single_writer(point):
+    """Kill the leader at each crash point, keep its half-dead incarnation
+    RUNNING (zombie), fail over to a rival elector: the new incarnation
+    converges with zero leaks and the fenced zombie performs ZERO cloud
+    mutations after its fencing token is invalidated."""
+    crashes = chaos.CrashPoints(seed=SEED)
+    queued = point == "after_qr_create"
+    mid_delete = point in ("mid_delete_after_pool_delete", "mid_drain")
+    opts = _opts(crashes=crashes, qr_step_latency=0.3 if queued else 0.02)
+    renv = RestartableEnv(opts)
+
+    lost = asyncio.Event()
+    gate = _GatedClient(renv.client.store)
+    a = LeaderElector(gate, identity="a", on_lost=lost.set, **FAST)
+    await a.run_until_leading()
+    token_a = a.fence()
+    env_a = await renv.start(fence=token_a)
+
+    name = "fo0"
+    ann = QUEUED if queued else None
+    if mid_delete:
+        await renv.client.create(make_nodeclaim(name, annotations=ann))
+        await renv.wait_ready(name, timeout=25)
+        if point == "mid_drain":
+            await renv.client.create(Pod(
+                metadata=ObjectMeta(name="payload", namespace="default"),
+                spec=PodSpec(node_name=f"gke-kaito-{name}-w0")))
+        # a big budget: the zombie keeps crashing on every retry, so it can
+        # never finish this work itself — the rival must
+        crashes.arm(point, times=1000)
+        await renv.client.delete(NodeClaim, name)
+    else:
+        crashes.arm(point, times=1000)
+        await renv.client.create(make_nodeclaim(name, annotations=ann))
+    await asyncio.wait_for(crashes.crashed.wait(), 20)
+
+    # The "crash" took the renew path with it: lease traffic blackholes.
+    # The zombie's OTHER tasks keep running — that is the scenario.
+    gate.gated = True
+    await asyncio.wait_for(lost.wait(), 15)
+    assert not token_a.valid()
+    with pytest.raises(FencedError):
+        token_a.check()
+    await asyncio.sleep(0.3)  # drain reconciles that pre-dated the fence flip
+    baseline = _mutations(env_a.provider)
+
+    # rival steals the expired lease; the crash schedule is disarmed for it
+    crashes.disarm()
+    b = LeaderElector(InMemoryClient(renv.client.store), identity="b", **FAST)
+    await asyncio.wait_for(b.run_until_leading(), 15)
+    env_b = Env(opts, client=renv.client, cloud=renv.cloud, fence=b.fence())
+    await env_b.__aenter__()
+    try:
+        if mid_delete:
+            await env_b.wait_gone(name, timeout=30)
+            await _assert_no_leaks(renv, set())
+        else:
+            await env_b.wait_ready(name, timeout=30)
+            await _assert_no_leaks(
+                renv, {name}, qrs={name} if queued else frozenset())
+        # soak past several zombie retry windows: a fenced dequeue must
+        # never reach the cloud
+        await asyncio.sleep(1.0)
+        assert _mutations(env_a.provider) == baseline, \
+            "deposed leader mutated the cloud after fencing invalidation"
+        # the rival's convergence generated watch events the zombie's pumps
+        # also saw — every one of those dequeues must have been fenced
+        fenced = sum(c.fenced_total for c in env_a.manager.controllers)
+        assert fenced > 0, "zombie never exercised the fence drop path"
+    finally:
+        await env_b.__aexit__()
+        await b.stop()
+        await renv.crash()   # finally kill the zombie
+        await a.stop()
