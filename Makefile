@@ -49,7 +49,7 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning wave benchmark; fails on cloud-call budget regression
+bench: ## Provisioning benchmarks; fails on BENCH_pr02 cloud-call or BENCH_pr04 poll/pinned-worker budget regressions
 	$(PY) -m bench.bench_provision
 
 .PHONY: bench-headline

@@ -13,12 +13,24 @@ Two harnesses, both envtest + FakeCloud, no network:
   serially) and once with the fast path (one bulk list + bounded fan-out).
   The before/after ratio is the PR's headline claim.
 
-Writes ``BENCH_pr02.json`` with ``--write``; by default (and under
-``make bench``) it re-measures and REFUSES to pass if cloud-call counts
-regress beyond the budget recorded in that file.
+PR 4 adds the **worker-constrained wave** (``BENCH_pr04.json``): the same
+claim wave with the lifecycle worker pool squeezed to 8 and slow simulated
+LROs, run once against the blocking create/delete shape
+(``EnvtestOptions.blocking_create`` — a worker pinned per create for the
+full slice-create duration, client-side LRO polling per operation) and once
+against the operation tracker (non-blocking state machines, one batched
+``nodepools.list`` per tick). Reports ready_p95 / ready_wall,
+**pinned-worker-seconds** (total time lifecycle workers spent inside
+reconcile), and the wave-wide poll-call count
+(``nodepools.get`` + ``nodepools.list`` + client-side LRO polls).
+
+Writes ``BENCH_pr02.json`` with ``--write`` and ``BENCH_pr04.json`` with
+``--write-pr04``; by default (and under ``make bench``) it re-measures and
+REFUSES to pass if cloud-call counts regress beyond the budgets recorded in
+EITHER file.
 
 Usage: python -m bench.bench_provision [--claims 100] [--pools 100]
-                                       [--write] [--fast]
+                                       [--write] [--write-pr04] [--fast]
 """
 
 from __future__ import annotations
@@ -34,6 +46,7 @@ from collections import defaultdict
 from pathlib import Path
 
 BENCH_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr02.json"
+BENCH_PR04_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr04.json"
 
 # Simulated apiserver round-trip for the GC-pass harness. The in-memory
 # store answers in microseconds; a serial-per-pool list path only shows its
@@ -218,6 +231,143 @@ async def bench_wave(n_claims: int, shape: str = "tpu-v5e-8") -> dict:
     }
 
 
+# ----------------------------------------------------- worker-constrained wave
+
+async def bench_constrained_wave(n_claims: int = 200, workers: int = 8,
+                                 blocking: bool = False,
+                                 create_latency: float = 0.4) -> dict:
+    """The PR 4 scenario: ``n_claims`` through a lifecycle pool squeezed to
+    ``workers`` with slow simulated LROs. Blocking mode pins one worker per
+    create for the whole LRO + node wait (wave throughput bounded by worker
+    count); tracker mode frees the worker after ``begin_create`` (throughput
+    bounded by cloud latency). Reports latency, pinned-worker-seconds, and
+    the wave-wide poll-call shape."""
+    from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+    from gpu_provisioner_tpu.controllers.lifecycle import LifecycleOptions
+    from gpu_provisioner_tpu.controllers.termination import TerminationOptions
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    opts = EnvtestOptions(
+        create_latency=create_latency, delete_latency=0.05,
+        node_join_delay=0.0, node_ready_delay=0.0,
+        node_wait_interval=0.02, node_wait_attempts=600,
+        gc_interval=5.0, leak_grace=5.0,
+        max_concurrent_reconciles=workers,
+        blocking_create=blocking,
+        lifecycle=LifecycleOptions(termination_requeue=0.2,
+                                   registration_requeue=0.2,
+                                   inprogress_requeue=0.2),
+        termination=TerminationOptions(requeue=0.2, instance_requeue=0.2))
+    async with Env(opts) as env:
+        # pinned-worker-seconds: total wall time lifecycle workers spend
+        # INSIDE reconcile — the resource the blocking shape burns (a
+        # parked worker is pinned; a requeued claim costs nothing)
+        pinned = {"seconds": 0.0}
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        prev_hook = lifecycle._metrics_hook
+
+        def hook(name, duration, err):
+            pinned["seconds"] += duration
+            if prev_hook is not None:
+                prev_hook(name, duration, err)
+        lifecycle.set_metrics_hook(hook)
+
+        async def provision(i: int) -> float:
+            t = time.perf_counter()
+            await env.client.create(make_nodeclaim(f"cw{i:04d}", "tpu-v5e-8",
+                                                   workspace=f"ws{i}"))
+            await env.wait_ready(f"cw{i:04d}", timeout=600, poll=0.1)
+            return time.perf_counter() - t
+
+        t0 = time.perf_counter()
+        readies = await asyncio.gather(*(provision(i)
+                                         for i in range(n_claims)))
+        ready_wall = time.perf_counter() - t0
+        ready_pinned = pinned["seconds"]
+        # poll-call shape at the end of the up-wave: point gets + batched
+        # lists + client-side LRO polls (operations.get against a real API)
+        np_calls = env.cloud.nodepools.calls
+        polls = {k: np_calls.get(k, 0)
+                 for k in ("get", "list", "operation_poll")}
+
+        t1 = time.perf_counter()
+        for i in range(n_claims):
+            await env.client.delete(NodeClaim, f"cw{i:04d}")
+        await asyncio.gather(*(env.wait_gone(f"cw{i:04d}", timeout=600)
+                               for i in range(n_claims)))
+        delete_wall = time.perf_counter() - t1
+        leaked = len(env.cloud.nodepools.pools)
+        total_pinned = pinned["seconds"]
+    return {
+        "claims": n_claims,
+        "workers": workers,
+        "blocking": blocking,
+        "create_latency_s": create_latency,
+        "ready_p50_s": round(statistics.median(readies), 4),
+        "ready_p95_s": round(_pctl(readies, 0.95), 4),
+        "ready_wall_s": round(ready_wall, 3),
+        "delete_wall_s": round(delete_wall, 3),
+        "pinned_worker_seconds_ready": round(ready_pinned, 3),
+        "pinned_worker_seconds_total": round(total_pinned, 3),
+        "poll_calls": polls,
+        "poll_calls_total": sum(polls.values()),
+        "leaked_pools": leaked,
+    }
+
+
+async def run_constrained(n_claims: int, workers: int = 8) -> dict:
+    before = await bench_constrained_wave(n_claims, workers, blocking=True)
+    after = await bench_constrained_wave(n_claims, workers, blocking=False)
+    return {
+        "bench": "nonblocking-provisioning",
+        "pr": 4,
+        "before": before,
+        "after": after,
+        "ready_wall_speedup": round(
+            before["ready_wall_s"] / max(after["ready_wall_s"], 1e-9), 2),
+        "pinned_worker_reduction": round(
+            before["pinned_worker_seconds_total"]
+            / max(after["pinned_worker_seconds_total"], 1e-9), 2),
+        "poll_call_reduction": round(
+            before["poll_calls_total"] / max(after["poll_calls_total"], 1),
+            2),
+    }
+
+
+def make_pr04_budget(results: dict) -> dict:
+    """3× headroom over the tracker-mode measurement (both ceilings scale
+    with wall clock — the gate catches a reintroduced per-operation polling
+    loop or worker-pinning path, not a slow CI box)."""
+    after = results["after"]
+    return {
+        "constrained_wave_poll_calls": 3 * after["poll_calls_total"],
+        "constrained_wave_pinned_worker_seconds": round(
+            3.0 * after["pinned_worker_seconds_total"], 1),
+    }
+
+
+def check_pr04_budget(results: dict, recorded: dict) -> list[str]:
+    budget = recorded.get("budget", {})
+    after = results["after"]
+    out: list[str] = []
+    ceiling = budget.get("constrained_wave_poll_calls")
+    if ceiling is not None and after["poll_calls_total"] > ceiling:
+        out.append(
+            f"constrained wave poll calls regressed: "
+            f"{after['poll_calls_total']} > budget {ceiling} "
+            "(per-operation polling back?)")
+    ceiling = budget.get("constrained_wave_pinned_worker_seconds")
+    if ceiling is not None and \
+            after["pinned_worker_seconds_total"] > ceiling:
+        out.append(
+            f"constrained wave pinned-worker-seconds regressed: "
+            f"{after['pinned_worker_seconds_total']} > budget {ceiling} "
+            "(workers parked inside reconcile again?)")
+    return out
+
+
 # ------------------------------------------------------------------- budget
 
 def check_budget(results: dict, recorded: dict) -> list[str]:
@@ -290,35 +440,64 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--claims", type=int, default=100)
     ap.add_argument("--pools", type=int, default=100)
+    ap.add_argument("--constrained-claims", type=int, default=200)
+    ap.add_argument("--workers", type=int, default=8,
+                    help="lifecycle worker pool for the constrained wave")
     ap.add_argument("--fast", action="store_true",
                     help="small sizes for smoke runs")
     ap.add_argument("--no-wave", action="store_true")
+    ap.add_argument("--no-constrained", action="store_true",
+                    help="skip the PR 4 worker-constrained wave")
     ap.add_argument("--write", action="store_true",
                     help="rewrite BENCH_pr02.json with fresh numbers+budget")
+    ap.add_argument("--write-pr04", action="store_true",
+                    help="rewrite BENCH_pr04.json with fresh numbers+budget")
     args = ap.parse_args(argv)
     if args.fast:
         args.claims, args.pools = 10, 20
+        args.constrained_claims = 24
 
     results = asyncio.run(run(args.claims, args.pools,
                               with_wave=not args.no_wave))
     print(json.dumps(results, indent=2))
 
+    rc = 0
     if args.write:
         results["budget"] = make_budget(results)
         BENCH_FILE.write_text(json.dumps(results, indent=2) + "\n")
         print(f"wrote {BENCH_FILE}", file=sys.stderr)
-        return 0
-
-    if BENCH_FILE.exists():
+    elif BENCH_FILE.exists():
         recorded = json.loads(BENCH_FILE.read_text())
         violations = check_budget(results, recorded)
+        for v in violations:
+            print(f"BUDGET REGRESSION: {v}", file=sys.stderr)
         if violations:
-            for v in violations:
-                print(f"BUDGET REGRESSION: {v}", file=sys.stderr)
-            return 1
-        print("cloud-call budget OK "
-              f"(recorded in {BENCH_FILE.name})", file=sys.stderr)
-    return 0
+            rc = 1
+        else:
+            print("cloud-call budget OK "
+                  f"(recorded in {BENCH_FILE.name})", file=sys.stderr)
+
+    if args.no_constrained:
+        return rc
+
+    pr04 = asyncio.run(run_constrained(args.constrained_claims,
+                                       args.workers))
+    print(json.dumps(pr04, indent=2))
+    if args.write_pr04:
+        pr04["budget"] = make_pr04_budget(pr04)
+        BENCH_PR04_FILE.write_text(json.dumps(pr04, indent=2) + "\n")
+        print(f"wrote {BENCH_PR04_FILE}", file=sys.stderr)
+    elif BENCH_PR04_FILE.exists():
+        recorded = json.loads(BENCH_PR04_FILE.read_text())
+        violations = check_pr04_budget(pr04, recorded)
+        for v in violations:
+            print(f"BUDGET REGRESSION: {v}", file=sys.stderr)
+        if violations:
+            rc = 1
+        else:
+            print("constrained-wave budget OK "
+                  f"(recorded in {BENCH_PR04_FILE.name})", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

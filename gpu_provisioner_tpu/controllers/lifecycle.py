@@ -62,6 +62,12 @@ class LifecycleOptions:
     termination_requeue: float = 5.0        # controller.go:246
     registration_requeue: float = 2.0
     launch_cache_ttl: float = 3600.0        # controller.go:81 (1h)
+    # Requeue cadence while a tracked create LRO is in flight
+    # (CreateError reason=CreateInProgress). A safety net, not the wake
+    # mechanism: the operation tracker injects the claim back into the
+    # workqueue the tick its operation completes — this only bounds how
+    # long a claim can sit if that injection is ever missed.
+    inprogress_requeue: float = 5.0
 
 
 @dataclass
@@ -113,6 +119,7 @@ class NodeClaimLifecycleController:
         # aggregates errors with multierr, controller.go:149-157) — liveness
         # must still fire while launch is failing.
         requeues: list[float] = []
+        preserve = False
         error: Optional[Exception] = None
         for sub in (self._launch, self._registration, self._initialization,
                     self._liveness):
@@ -127,10 +134,12 @@ class NodeClaimLifecycleController:
                 return Result()  # nodeclaim was deleted by the sub-reconciler
             if res.requeue_after is not None:
                 requeues.append(res.requeue_after)
+            preserve = preserve or res.preserve_failures
         await self._flush_status(nc)
         if error is not None:
             raise error
-        return Result(requeue_after=min(requeues)) if requeues else Result()
+        return Result(requeue_after=min(requeues) if requeues else None,
+                      preserve_failures=preserve)
 
     async def _flush_status(self, nc: NodeClaim) -> None:
         def copy_status(obj):
@@ -197,12 +206,25 @@ class NodeClaimLifecycleController:
                     pass
                 return None
             except CreateError as e:
-                # Transient reasons (NodesNotReady, QueuedProvisioning)
-                # deliberately take the workqueue's exponential error backoff
-                # too: at fleet scale it is the self-stabilizing mechanism —
-                # a fixed retry cadence was measured to keep a 512-claim wave
-                # saturated indefinitely.
                 cs.set_false(LAUNCHED, e.reason, str(e))
+                if e.reason == "CreateInProgress":
+                    # Non-blocking provisioning: the operation tracker owns
+                    # the wait — this is progress, not failure. Requeue at
+                    # the in-progress cadence (no failure counter accrues,
+                    # no backoff ladder climbs) and let the tracker's
+                    # completion injection wake the claim the moment the
+                    # LRO resolves. preserve_failures: the lap must not
+                    # FORGET history either — a create that keeps landing
+                    # ERROR alternates fail→re-register, and wiping the
+                    # counter each lap would pin its retry cadence flat
+                    # instead of climbing the ladder.
+                    return Result(requeue_after=self.opts.inprogress_requeue,
+                                  preserve_failures=True)
+                # Other transient reasons (NodesNotReady, QueuedProvisioning)
+                # deliberately take the workqueue's exponential error backoff:
+                # at fleet scale it is the self-stabilizing mechanism — a
+                # fixed retry cadence was measured to keep a 512-claim wave
+                # saturated indefinitely.
                 raise
             self._launched[nc.metadata.uid] = _CacheEntry(created)
 

@@ -11,6 +11,7 @@ counters, workqueue depth/backlog gauges, and circuit-breaker state
 
 from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
+from ..providers import operations as ops
 from ..providers.cache import CACHE_STATS, CLOUD_CALLS
 from ..transport import BREAKER_HALF_OPEN, BREAKER_OPEN, BREAKERS
 
@@ -141,6 +142,27 @@ CLOUD_API_CALLS = _get_or_create(
     Gauge, "tpu_provisioner_cloud_api_calls",
     "Cloud API calls by endpoint (scope.method, sampled).", ["endpoint"])
 
+# ------------------------------------------------- non-blocking provisioning
+# The operation tracker's surface: how many LROs the multiplexer is carrying
+# right now, how many batched polls it has issued (one nodepools.list per
+# tick, vs one get per op per interval before), and how long operations take
+# end-to-end (begin_create/begin_delete → resolved).
+
+INFLIGHT_OPERATIONS = _get_or_create(
+    Gauge, "tpu_provisioner_inflight_operations",
+    "In-flight tracked cloud operations by kind (sampled across live "
+    "operation trackers).", ["kind"])
+
+OPERATION_POLL_BATCHES = _get_or_create(
+    Gauge, "tpu_provisioner_operation_poll_batches",
+    "Cumulative batched operation polls — one nodepools.list resolving "
+    "every in-flight operation (sampled).", [])
+
+OPERATION_WAIT = _get_or_create(
+    Histogram, "tpu_provisioner_operation_wait_seconds",
+    "Tracked operation duration from registration to resolution.", ["kind"],
+    buckets=(0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800))
+
 _CACHE_GAUGES = (
     ("hits", INSTANCE_CACHE_HITS),
     ("misses", INSTANCE_CACHE_MISSES),
@@ -170,6 +192,17 @@ def update_runtime_gauges(manager) -> None:
             gauge.labels(name).set(stats[stat])
     for endpoint, calls in CLOUD_CALLS.items():
         CLOUD_API_CALLS.labels(endpoint).set(calls)
+    inflight = {ops.OP_CREATE: 0, ops.OP_DELETE: 0}
+    for tracker in list(ops.TRACKERS):
+        for kind, n in tracker.inflight().items():
+            inflight[kind] = inflight.get(kind, 0) + n
+    for kind, n in inflight.items():
+        INFLIGHT_OPERATIONS.labels(kind).set(n)
+    OPERATION_POLL_BATCHES.set(ops.POLL_BATCHES["count"])
+    # completed-operation durations accumulate provider-side (that layer
+    # never imports prometheus) and drain into the histogram at scrape
+    for kind, seconds in ops.drain_operation_waits():
+        OPERATION_WAIT.labels(kind).observe(seconds)
     # Drop series for breakers whose client closed — a stale "open" reading
     # would keep an alert firing for an endpoint nothing gates on anymore.
     for name in _exported_breakers - set(BREAKERS):

@@ -125,8 +125,14 @@ class RecoveryController:
             elif (nc.metadata.deletion_timestamp is None
                   and (pool.status in (NP_PROVISIONING, NP_ERROR)
                        or not nc.status_conditions.is_true(LAUNCHED))):
-                # half-created: a previous incarnation died mid-create; the
-                # lifecycle re-drive resumes it through conflict adoption
+                # half-created: a previous incarnation died mid-create.
+                # Re-register the stranded LRO with the operation tracker
+                # (batched polling + completion wake) so resumption never
+                # blind-waits; with no tracker wired the lifecycle re-drive
+                # resumes it through conflict adoption instead.
+                if pool.status != NP_ERROR:
+                    provider.resume_create(pool.name,
+                                           pool.initial_node_count)
                 self._count("pool", pool.name, RECOVERY_ADOPTED,
                             "adopting half-created pool")
 

@@ -63,6 +63,7 @@ def build_controllers(client: Client, cloudprovider,
                       recovery_options: Optional[RecoveryOptions] = None,
                       crashes=None,
                       fence=None,
+                      tracker=None,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -93,7 +94,15 @@ def build_controllers(client: Client, cloudprovider,
     shard 0 alongside the GC loops; ``fence`` (a leadership FencingToken)
     is applied to EVERY controller — including the cloud-mutating GC and
     recovery singletons — so a deposed leader's workers drop items instead
-    of reconciling."""
+    of reconciling.
+
+    ``tracker`` (providers.operations.OperationTracker): when the instance
+    provider runs in non-blocking mode, completed create/delete operations
+    are injected straight into the lifecycle workqueue (the early-wake
+    seam) — a claim parked on ``Result(requeue_after=...)`` reconciles the
+    tick its LRO resolves. Tracked operations are keyed by pool name ==
+    claim name, so the injected request lands on the right shard's
+    controller by construction (foreign shards never see the tracker)."""
     if not 0 <= shard_index < shards:
         raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
     owns = (lambda name: True) if shards == 1 else \
@@ -121,11 +130,16 @@ def build_controllers(client: Client, cloudprovider,
 
     hardening = dict(reconcile_timeout=reconcile_timeout,
                      max_retries=max_retries)
-    controllers = [
+    lifecycle_controller = (
         Controller(lifecycle.NAME, lifecycle,
                    max_concurrent=max_concurrent_reconciles, **hardening)
         .watches(NodeClaim, map_fn=claim_map)
-        .watches(Node, map_fn=node_claim_map),
+        .watches(Node, map_fn=node_claim_map))
+    if tracker is not None:
+        # early wake: tracked-operation completion → lifecycle workqueue
+        tracker.subscribe(lambda op: lifecycle_controller.inject(op.name))
+    controllers = [
+        lifecycle_controller,
         Controller(termination.NAME, termination, max_concurrent=16,
                    **hardening)
         .watches(Node, map_fn=node_map),

@@ -26,6 +26,7 @@ from .controllers.registry import build_controllers
 from .controllers.termination import TerminationOptions
 from .fake.cloud import FakeCloud
 from .providers.instance import InstanceProvider, ProviderConfig
+from .providers.operations import OperationTracker
 from .runtime import InMemoryClient, Manager
 from .runtime.events import Recorder
 
@@ -39,6 +40,16 @@ class EnvtestOptions:
     qr_step_latency: float = 0.02
     node_wait_interval: float = 0.02
     node_wait_attempts: int = 30
+    # Non-blocking provisioning (providers/operations.py): the default
+    # wiring runs create/delete as resumable state machines over a shared
+    # OperationTracker — one batched nodepools.list per tick drives every
+    # in-flight LRO, and completions are injected into the lifecycle
+    # workqueue. blocking_create=True restores the worker-pinning shape
+    # (poll_until_done + node-wait sleep loop) — kept as the benchmark
+    # baseline, like ProviderConfig.legacy_list for the read path.
+    blocking_create: bool = False
+    # Tracker tick cadence; defaults to node_wait_interval.
+    operation_poll_interval: Optional[float] = None
     # Read-through instance cache (providers/cache.py), scaled to envtest's
     # time compression (real default is 1s). 0 disables positive caching
     # but keeps singleflight coalescing.
@@ -47,7 +58,8 @@ class EnvtestOptions:
     gc_interval: float = 0.2
     leak_grace: float = 0.2
     lifecycle: LifecycleOptions = field(default_factory=lambda: LifecycleOptions(
-        termination_requeue=0.05, registration_requeue=0.05))
+        termination_requeue=0.05, registration_requeue=0.05,
+        inprogress_requeue=0.1))
     termination: TerminationOptions = field(default_factory=lambda: TerminationOptions(
         requeue=0.05, instance_requeue=0.05))
     # Scaled-down reference toleration (10 min → 30 s): must stay well above
@@ -147,6 +159,17 @@ class Env:
                 cache_negative_ttl=self.opts.instance_cache_negative_ttl),
             queued=self.cloud.queuedresources,
             crashes=self.opts.crashes, fence=fence)
+        self.tracker = None
+        if not self.opts.blocking_create:
+            # the tracker polls through the provider's COUNTED seam so its
+            # batched lists show up in the per-endpoint cloud-call
+            # accounting, and through the same (informer/chaos-wrapped)
+            # kube client the provider reads nodes with
+            self.tracker = OperationTracker(
+                self.provider.nodepools, kube,
+                interval=(self.opts.operation_poll_interval
+                          or self.opts.node_wait_interval))
+            self.provider.tracker = self.tracker
         self.cloudprovider = MetricsDecorator(TPUCloudProvider(
             self.provider, repair_toleration=self.opts.repair_toleration))
         self.recorder = Recorder(self.client)
@@ -165,12 +188,15 @@ class Env:
             recovery_options=RecoveryOptions(
                 interval=self.opts.recovery_interval,
                 grace=self.opts.leak_grace),
-            crashes=self.opts.crashes, fence=fence)
+            crashes=self.opts.crashes, fence=fence,
+            tracker=self.tracker)
         self.manager = Manager(self.client).register(*controllers)
 
     async def __aenter__(self) -> "Env":
         if self.informers is not None:
             await self.informers.start()   # sync before the first reconcile
+        if self.tracker is not None:
+            self.tracker.start()
         self.eviction.start()
         await self.manager.start()
         return self
@@ -178,8 +204,19 @@ class Env:
     async def __aexit__(self, *exc) -> None:
         await self.manager.stop()
         await self.eviction.stop()
+        if self.tracker is not None:
+            await self.tracker.stop()
         if self.informers is not None:
             await self.informers.stop()
+        # Task-leak gate: THIS Env's poller must never outlive the Env — a
+        # leaked tracker task would keep polling a dead incarnation's cloud
+        # seam forever. Scoped to self.tracker (a RestartableEnv zombie's
+        # rival legitimately keeps its own tracker alive). Suppressed when
+        # the body is already raising, so it never masks a test failure.
+        if (self.tracker is not None and self.tracker.task_alive()
+                and not (exc and exc[0] is not None)):
+            raise RuntimeError(
+                "operation-tracker poller task outlived its Env")
 
     def informer_cache_sizes(self) -> dict[str, int]:
         """Cached object count per kind (empty when informers are off) —

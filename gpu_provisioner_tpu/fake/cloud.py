@@ -37,17 +37,24 @@ from .builders import make_node
 
 class TimedOperation:
     """LRO that completes ``latency`` seconds after creation; optionally runs
-    ``on_done`` (async) once, then returns ``result`` or raises ``error``."""
+    ``on_done`` (async) once, then returns ``result`` or raises ``error``.
+    ``on_poll`` (sync) fires on every ``done()`` check — the accounting hook
+    for client-side LRO polling, which against the real API is one
+    ``operations.get`` HTTP round-trip per check."""
 
     def __init__(self, latency: float = 0.0, result=None,
-                 error: Optional[Exception] = None, on_done=None):
+                 error: Optional[Exception] = None, on_done=None,
+                 on_poll=None):
         self._deadline = time.monotonic() + latency
         self._result = result
         self._error = error
         self._on_done = on_done
+        self._on_poll = on_poll
         self._fired = False
 
     async def done(self) -> bool:
+        if self._on_poll is not None:
+            self._on_poll()
         if time.monotonic() < self._deadline:
             return False
         if not self._fired:
@@ -139,6 +146,11 @@ class FakeNodePoolsAPI(_FaultInjector):
         for name in list(self._pending):
             await self._settle(name)
 
+    def _count_op_poll(self) -> None:
+        # one client-side done() check == one operations.get round-trip
+        # against the real API; the non-blocking tracker never issues these
+        self.calls["operation_poll"] += 1
+
     async def begin_create(self, pool: NodePool):
         await self._settle_all()
         await self._acheck("begin_create")
@@ -170,7 +182,8 @@ class FakeNodePoolsAPI(_FaultInjector):
             await self._settle(pool.name)
 
         return TimedOperation(self.cloud.create_latency, result=stored,
-                              on_done=on_done, error=error)
+                              on_done=on_done, error=error,
+                              on_poll=self._count_op_poll)
 
     async def get(self, name: str) -> NodePool:
         await self._settle_all()
@@ -194,7 +207,8 @@ class FakeNodePoolsAPI(_FaultInjector):
         async def on_done():
             await self._settle(name)
 
-        return TimedOperation(self.cloud.delete_latency, on_done=on_done)
+        return TimedOperation(self.cloud.delete_latency, on_done=on_done,
+                              on_poll=self._count_op_poll)
 
     async def list(self) -> list[NodePool]:
         await self._settle_all()

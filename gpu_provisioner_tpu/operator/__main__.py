@@ -160,6 +160,14 @@ async def run_real(opts) -> int:
         ProviderConfig(project=cfg.project_id, zone=cfg.location,
                        cluster=cfg.cluster_name),
         queued=queued)
+    from ..providers.operations import OperationTracker
+
+    # Non-blocking provisioning: one background poller multiplexes every
+    # in-flight create/delete LRO off a single batched nodepools.list per
+    # tick; lifecycle workers are never parked for a slice-create duration.
+    tracker = OperationTracker(provider.nodepools, kube,
+                               interval=provider.cfg.node_wait_interval)
+    provider.tracker = tracker
     cloudprovider = MetricsDecorator(TPUCloudProvider(
         provider, repair_toleration=opts.repair_toleration_seconds))
 
@@ -182,7 +190,8 @@ async def run_real(opts) -> int:
         max_concurrent_reconciles=opts.max_concurrent_reconciles,
         node_repair=opts.feature_gates.node_repair,
         cluster=cfg.cluster_name,
-        shards=opts.shards, shard_index=opts.shard_index)
+        shards=opts.shards, shard_index=opts.shard_index,
+        tracker=tracker)
     manager = Manager(kube).register(*controllers)
 
     stop = asyncio.Event()
@@ -211,6 +220,7 @@ async def run_real(opts) -> int:
             c.fence = fence
 
     await kube.start()  # informers sync before the first reconcile
+    tracker.start()
     eviction.start()
     await manager.start()
     runners = await start_servers(manager, opts.metrics_port,
@@ -230,6 +240,7 @@ async def run_real(opts) -> int:
     finally:
         await manager.stop()
         await eviction.stop()
+        await tracker.stop()
         await kube.stop()
         if elector is not None:
             await elector.stop()

@@ -16,3 +16,7 @@ from .instance import (  # noqa: F401
     Instance, InstanceProvider, STATE_CREATING, STATE_DELETING, STATE_FAILED,
     STATE_SUCCEEDED, nodepool_name_valid, parse_nodepool_from_provider_id,
 )
+from .operations import (  # noqa: F401
+    BackoffLadder, OperationTracker, TrackedOperation,
+    OP_CREATE, OP_DELETE, PHASE_FAILED, PHASE_IN_PROGRESS, PHASE_SUCCEEDED,
+)

@@ -15,13 +15,20 @@ machine instead of a blocking LRO: create() returns fast and raises a
 retryable error while the queue drains, so a reconcile worker is never parked
 for the hours a stockout can last (SURVEY.md §7 hard part 2 — deliberate
 departure from the reference's PollUntilDone-blocks-worker model).
+
+With an :class:`~..providers.operations.OperationTracker` wired (the
+production/envtest default), the node-pool LRO path gets the same treatment:
+create()/delete() are resumable state machines that register the in-flight
+operation with the shared multiplexer and return immediately — one batched
+``nodepools.list`` per tracker tick drives every wait, and no worker is ever
+pinned for a slice-create duration. The blocking shape survives tracker-less
+(direct/tooling use, and as the BENCH_pr04 baseline).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-import random
 import re
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -38,6 +45,7 @@ from ..errors import (
 from ..runtime.client import Client
 from ..scheduling import Requirements
 from .cache import CountingAPI, ReadThroughCache
+from .operations import BackoffLadder, OP_DELETE, OperationTracker
 from .gcp import (
     APIError, NodePool, NodePoolConfig, NodePoolsAPI, PlacementPolicy,
     QueuedResource, QueuedResourcesAPI, poll_until_done,
@@ -165,7 +173,8 @@ class InstanceProvider:
     def __init__(self, nodepools: NodePoolsAPI, kube: Client,
                  config: Optional[ProviderConfig] = None,
                  queued: Optional[QueuedResourcesAPI] = None,
-                 crashes=None, fence=None):
+                 crashes=None, fence=None,
+                 tracker: Optional[OperationTracker] = None):
         # every cloud seam is wrapped in a per-endpoint call counter so the
         # /metrics surface (and the bench harness) can see exactly what the
         # control loops cost the cloud APIs
@@ -182,6 +191,13 @@ class InstanceProvider:
         # leader (the controller-level fence only gates new dequeues).
         self.crashes = crashes
         self.fence = fence
+        # Operation tracker (providers/operations.py): when wired, create()
+        # and delete() are non-blocking resumable state machines — they
+        # register the in-flight LRO with the shared multiplexer and return
+        # immediately; the single tracker poller drives every wait off one
+        # batched nodepools.list per tick. With no tracker (direct/tooling
+        # construction, the bench baseline) the blocking paths below remain.
+        self.tracker = tracker
         # Read-through caches (providers/cache.py): point lookups on the
         # cloud seams, singleflight-coalesced, explicitly invalidated by
         # create/delete/state transitions below.
@@ -245,6 +261,14 @@ class InstanceProvider:
 
     # ------------------------------------------------------------- create
     async def create(self, nc: NodeClaim) -> Instance:
+        """Resumable create. With an operation tracker wired this NEVER
+        blocks on the cloud: it either consumes a completed tracked
+        operation (returning the Instance), registers a new one and raises a
+        retryable ``CreateError(reason="CreateInProgress")``, or — for a
+        requeued reconcile whose operation is still in flight — raises the
+        same after one dict lookup and zero cloud calls. Without a tracker
+        the original blocking shape (LRO poll + node wait) remains for
+        direct/tooling use and as the bench baseline."""
         name = nc.metadata.name
         if not nodepool_name_valid(name):
             raise CreateError(
@@ -260,6 +284,14 @@ class InstanceProvider:
             raise CreateError(str(e), reason="UnresolvableShape") from e
         capacity_type = self._capacity_type(reqs)
 
+        if self.tracker is not None:
+            op = self.tracker.poke(name)
+            if op is not None:
+                consumed = await self._consume_tracked_create(op, name, shape)
+                if consumed is not None:
+                    return consumed
+                # None: a resolved delete freed the name — fresh create
+
         if self._queued_mode(nc, reqs):
             await self._ensure_queued_resource(nc, shape, capacity_type)
 
@@ -270,6 +302,14 @@ class InstanceProvider:
             self._fence_check()
             op = await self.nodepools.begin_create(pool)
             self._crash("after_pool_begin_create", name)
+            if self.tracker is not None:
+                # hand the LRO + node wait to the multiplexer and free the
+                # worker; the reconciler requeues (woken early by the
+                # tracker-completion injection seam)
+                self._register_create(name, shape.hosts)
+                raise CreateError(
+                    f"nodepool {name} create in progress; requeueing",
+                    reason="CreateInProgress")
             # poll at the node-wait cadence: the default 1s LRO poll left a
             # completed create unobserved for up to a full second — at
             # envtest/production config alike, the node wait owns pacing
@@ -278,11 +318,16 @@ class InstanceProvider:
             if e.conflict:
                 # Crash-restart tolerance: a create from a previous
                 # incarnation (or a racing replica) owns this pool. Adopt
-                # it — resume the in-flight LRO by polling the pool's own
-                # state — rather than blind-waiting for nodes a pool that
-                # lands in ERROR will never produce (reference:
-                # instance.go:106-110, minus its blind wait).
+                # it — resume the in-flight LRO by tracking (or polling)
+                # the pool's own state — rather than blind-waiting for
+                # nodes a pool that lands in ERROR will never produce
+                # (reference: instance.go:106-110, minus its blind wait).
                 log.info("nodepool %s create already in progress, adopting", name)
+                if self.tracker is not None:
+                    self._register_create(name, shape.hosts)
+                    raise CreateError(
+                        f"nodepool {name} create adopted; requeueing",
+                        reason="CreateInProgress") from e
                 await self._adopt_inflight_create(name)
             elif e.exhausted:
                 raise InsufficientCapacityError(
@@ -299,6 +344,102 @@ class InstanceProvider:
         self._pool_cache.invalidate(name)
         created = await self._get_pool(name)
         return self._to_instance(created, shape=shape, nodes=nodes)
+
+    async def _consume_tracked_create(self, op, name: str,
+                                      shape: cat.SliceShape
+                                      ) -> Optional[Instance]:
+        """Act on the tracked operation for ``name``. Returns None when the
+        parked op was a RESOLVED delete (e.g. GC reaped a previous pool
+        under this name and nothing ever consumed the outcome) — the name
+        is free again and the caller proceeds with a fresh create."""
+        if op.kind == OP_DELETE:
+            if op.in_progress:
+                # this pool's teardown (finalize/GC) is still in flight —
+                # the name frees up once the delete op resolves
+                raise CreateError(
+                    f"nodepool {name} is being deleted; requeueing",
+                    reason="CreateInProgress")
+            # resolved teardown nobody consumed (a reaped claimless pool's
+            # delete has no second delete() call): pop it or a NodeClaim
+            # reusing the name would see "being deleted" forever
+            self.tracker.pop(name)
+            self._pool_cache.invalidate(name)
+            return None
+        if op.in_progress:
+            raise CreateError(
+                f"nodepool {name} create in progress; requeueing",
+                reason="CreateInProgress")
+        self.tracker.pop(name)
+        # terminal either way: any entry cached during the wait predates
+        # the outcome (the blocking path invalidates at the same point)
+        self._pool_cache.invalidate(name)
+        if not op.succeeded:
+            raise CreateError(op.message, reason=op.reason or "LaunchFailed")
+        # cut line: the create LRO has completed server-side but nothing —
+        # cache invalidation, node wait, claim status — has recorded it yet
+        self._crash("before_lro_done", name)
+        try:
+            created = await self._get_pool(name)
+        except APIError as e:
+            if e.not_found:
+                self._pool_cache.invalidate(name)
+                raise CreateError(
+                    f"nodepool {name} vanished after its create completed; "
+                    "requeueing", reason="CreateInProgress") from e
+            raise CreateError(f"reading created nodepool {name}: {e}") from e
+        nodes = ready_workers(await self._nodes_of_pool(name))
+        return self._to_instance(created, shape=shape, nodes=nodes)
+
+    def _register_create(self, name: str, hosts: int) -> None:
+        self.tracker.track_create(name, hosts, self._create_budget(hosts))
+
+    def _create_budget(self, hosts: int) -> float:
+        """Tracked-create budget: the adoption wait (LRO phase) plus the
+        host-scaled node wait — the same two budgets the blocking path
+        spends sequentially."""
+        attempts = self.cfg.node_wait_attempts + 5 * (hosts - 1)
+        return ((self.cfg.node_wait_attempts + attempts)
+                * self.cfg.node_wait_interval)
+
+    def _delete_budget(self) -> float:
+        return 2 * self.cfg.node_wait_attempts * self.cfg.node_wait_interval
+
+    def resume_create(self, name: str, hosts: int) -> bool:
+        """Recovery seam: re-register a stranded in-flight create (an LRO a
+        dead incarnation issued) with the tracker, so the startup resync
+        resumes it through the batched poller instead of leaving the claim
+        to rediscover it via conflict adoption. Returns False when no
+        tracker is wired (the lifecycle re-drive then owns resumption)."""
+        if self.tracker is None:
+            return False
+        self._register_create(name, max(1, hosts))
+        return True
+
+    async def create_and_wait(self, nc: NodeClaim,
+                              timeout: float = 120.0) -> Instance:
+        """Blocking driver over the resumable state machine — for direct
+        provider use (tests, tooling) with no reconciler to own the requeue
+        loop. Without a tracker a single ``create()`` already blocks."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            try:
+                return await self.create(nc)
+            except CreateError as e:
+                if e.reason != "CreateInProgress":
+                    raise
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    raise
+                op = (self.tracker.poke(nc.metadata.name)
+                      if self.tracker is not None else None)
+                if op is not None and op.in_progress:
+                    try:
+                        await asyncio.wait_for(op.done.wait(),
+                                               timeout=remaining)
+                    except asyncio.TimeoutError:
+                        raise e from None
+                else:
+                    await asyncio.sleep(self.cfg.node_wait_interval)
 
     def _crash(self, point: str, key: str) -> None:
         if self.crashes is not None:
@@ -325,8 +466,7 @@ class InstanceProvider:
         read-through cache (coalesced; ttl ≪ budget) and, against the fake
         cloud, drive the server-side LRO settle."""
         budget = self.cfg.node_wait_attempts * self.cfg.node_wait_interval
-        deadline = asyncio.get_event_loop().time() + budget
-        interval = self.cfg.node_wait_interval
+        ladder = BackoffLadder(budget, self.cfg.node_wait_interval)
         while True:
             try:
                 pool = await self._get_pool(name)
@@ -351,13 +491,12 @@ class InstanceProvider:
                     reason="CreateInProgress")
             if pool.status != NP_PROVISIONING:
                 return  # RUNNING/RECONCILING — fall through to the node wait
-            if asyncio.get_event_loop().time() >= deadline:
+            if ladder.expired():
                 raise CreateError(
                     f"nodepool {name} still PROVISIONING after {budget:.0f}s "
                     "adopted-create wait; requeueing",
                     reason="CreateInProgress")
-            await asyncio.sleep(interval)
-            interval = min(interval * 1.5, budget / 4)
+            await ladder.sleep()
 
     def _queued_mode(self, nc: NodeClaim, reqs: Requirements) -> bool:
         if self.queued is None:
@@ -529,15 +668,16 @@ class InstanceProvider:
         (generalizes instance.go:124-149; correlation by the GKE node-pool
         label, the analog of getNodesByName's agentpool labels :371-385).
 
-        Polls back off exponentially (base interval ×1.5, capped) within the
-        attempts×interval time budget: a provisioning wave of hundreds of
-        concurrent creates polling at the base rate melts the apiserver/event
-        loop, and a miss here is retryable anyway (NodesNotReady → workqueue
-        backoff owns the longer wait)."""
+        Polls back off exponentially along the shared ``BackoffLadder``
+        (base interval ×1.5, capped, jittered) within the attempts×interval
+        time budget: a provisioning wave of hundreds of concurrent creates
+        polling at the base rate melts the apiserver/event loop, and a miss
+        here is retryable anyway (NodesNotReady → workqueue backoff owns the
+        longer wait)."""
         attempts = self.cfg.node_wait_attempts + 5 * (hosts - 1)
         budget = attempts * self.cfg.node_wait_interval
-        deadline = asyncio.get_event_loop().time() + budget
-        interval = self.cfg.node_wait_interval
+        ladder = BackoffLadder(budget, self.cfg.node_wait_interval,
+                               jitter=self.cfg.node_wait_jitter)
         ready: list[Node] = []
         while True:
             # per-poll reads go through self.kube: wired behind the informer
@@ -548,11 +688,9 @@ class InstanceProvider:
             ready = ready_workers(nodes)
             if len(ready) >= hosts:
                 return ready
-            if asyncio.get_event_loop().time() >= deadline:
+            if ladder.expired():
                 break
-            await asyncio.sleep(interval
-                                * (1 + random.random() * self.cfg.node_wait_jitter))
-            interval = min(interval * 1.5, budget / 4)
+            await ladder.sleep()
         raise CreateError(
             f"nodepool {pool}: only {len(ready)}/{hosts} nodes appeared with "
             "providerIDs before timeout", reason="NodesNotReady")
@@ -676,8 +814,28 @@ class InstanceProvider:
         die before its pool ever exists — queued capacity stuck in the
         stockout ladder until launch liveness reaps the claim — and keying
         the cleanup off a successful pool get would leak that queued
-        resource forever (found by the stuck-queue chaos profile)."""
+        resource forever (found by the stuck-queue chaos profile).
+
+        With a tracker wired the delete is non-blocking: ``begin_delete``
+        registers a tracked delete op and returns immediately ("still
+        terminating"); subsequent calls consume the tracked outcome —
+        in flight → return at zero further cloud calls, succeeded → the
+        NodeClaimNotFoundError the finalizer is waiting for."""
         await self.delete_queued(name)
+        if self.tracker is not None:
+            top = self.tracker.poke(name)
+            if top is not None and top.kind == OP_DELETE:
+                if top.in_progress:
+                    return  # our own delete LRO is still running
+                self.tracker.pop(name)
+                self._pool_cache.invalidate(name)
+                if top.succeeded:
+                    # same post-completion hygiene as the blocking path:
+                    # the snapshot may still list the dying pool
+                    async with self._pool_snapshot_lock:
+                        self._pool_snapshot = None
+                    raise NodeClaimNotFoundError(f"nodepool {name} not found")
+                # DeleteTimeout: fall through and re-drive the live path
         # LIVE read, deliberately around the cache: delete decisions (skip
         # if already Deleting) must never ride a stale cached status.
         try:
@@ -685,6 +843,10 @@ class InstanceProvider:
         except APIError as e:
             if e.not_found:
                 self._pool_cache.invalidate(name)
+                if self.tracker is not None:
+                    # the pool is proven gone and this claim is unwinding —
+                    # nothing will ever consume an op parked under the name
+                    self.tracker.discard(name)
                 raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
             raise
         if pool.status == NP_STOPPING:
@@ -692,6 +854,11 @@ class InstanceProvider:
             # view so get() reports Deleting, not a stale RUNNING (every
             # other observed transition invalidates — keep the symmetry)
             self._pool_cache.invalidate(name)
+            if self.tracker is not None:
+                # adopt the stranded/out-of-band delete LRO: the tracker's
+                # completion wakes the finalizer instead of leaving it to
+                # rediscover the disappearance a fixed requeue later
+                self.tracker.track_delete(name, self._delete_budget())
             log.info("nodepool %s already deleting, skipping", name)
             return
         try:
@@ -700,6 +867,12 @@ class InstanceProvider:
             self._pool_cache.invalidate(name)  # state transition: Deleting
             # cut line: delete LRO issued (QR already cleaned up), unpolled
             self._crash("mid_delete_after_pool_delete", name)
+            if self.tracker is not None:
+                # non-blocking: hand the LRO to the multiplexer and report
+                # "still terminating" — the termination requeue (woken early
+                # on completion) consumes the outcome above
+                self.tracker.track_delete(name, self._delete_budget())
+                return
             await poll_until_done(op)
             # again after the poll: a read begun mid-delete may have cached
             # the dying pool between the first invalidation and completion
@@ -716,6 +889,8 @@ class InstanceProvider:
         except APIError as e:
             if e.not_found:
                 self._pool_cache.invalidate(name)
+                if self.tracker is not None:
+                    self.tracker.discard(name)
                 raise NodeClaimNotFoundError(f"nodepool {name} not found") from e
             raise
 

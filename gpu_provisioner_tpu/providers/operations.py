@@ -1,0 +1,474 @@
+"""Operation tracker: the shared LRO multiplexer behind non-blocking creates.
+
+PR 2 made the *read* path fast; this module unblocks the *write* path. The
+blocking shape — ``InstanceProvider.create()`` parked inside
+``poll_until_done`` plus a per-create node-wait sleep loop — pins one
+lifecycle worker for the full slice-create duration, so a 1000-claim wave
+(the reference's lifecycle concurrency regime) serializes behind
+``max_concurrent`` sleeping workers and polls the cloud once per in-flight
+operation per interval.
+
+``OperationTracker`` inverts that: a **single background poller** owns every
+in-flight create/delete LRO and node-wait, drives them all off **one batched
+``nodepools.list`` per tick** (O(1) cloud calls per tick instead of
+O(in-flight) per-pool ``get``s), applies per-operation deadlines, and backs
+its tick cadence off while nothing changes. Callers never block:
+
+- ``track_create(name, hosts, budget)`` / ``track_delete(name, budget)``
+  register an operation (idempotent — re-registering an in-flight op is a
+  no-op, which is what a requeued reconcile does);
+- ``poke(name)`` is an await-free snapshot of the operation's phase;
+- ``pop(name)`` consumes a terminal operation (the caller acts on the
+  outcome exactly once);
+- ``subscribe(cb)`` registers an async completion callback — the
+  controller-runtime wiring injects the pool's request back into the
+  lifecycle workqueue, so a ``Result(requeue_after=...)`` parked claim is
+  woken the tick its operation completes rather than a full requeue later.
+
+``BackoffLadder`` is the deadline/backoff ladder ``_adopt_inflight_create``
+and ``_wait_for_nodes`` each used to grow independently (base interval ×
+factor, capped at budget/4, jittered, inside an overall budget) — hoisted
+here so the blocking fallback paths and the tracker tick share one
+implementation.
+
+Metrics follow the providers.cache convention: this layer never imports
+prometheus; module-level registries (``TRACKERS``, ``POLL_BATCHES``,
+``drain_operation_waits``) are sampled by ``controllers/metrics.py`` at
+scrape time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+
+log = logging.getLogger("providers.operations")
+
+# Operation kinds.
+OP_CREATE = "create"
+OP_DELETE = "delete"
+
+# Operation phases (OperationPhase): InProgress until the poller resolves the
+# op, then exactly one terminal phase.
+PHASE_IN_PROGRESS = "InProgress"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+# GKE node-pool statuses the tick branches on (string literals to keep this
+# module import-light; values match providers.gcp NP_*).
+_NP_PROVISIONING = "PROVISIONING"
+_NP_RUNNING = "RUNNING"
+_NP_RECONCILING = "RECONCILING"
+_NP_STOPPING = "STOPPING"
+_NP_ERROR = "ERROR"
+
+# ---------------------------------------------------------------- registries
+# Live trackers (inflight gauges are point-in-time: they must be read off the
+# live objects; the weak set lets test/bench trackers die naturally).
+TRACKERS: "weakref.WeakSet[OperationTracker]" = weakref.WeakSet()
+
+# Cumulative batched-poll count across tracker instances (sampled into the
+# tpu_provisioner_operation_poll_batches gauge).
+POLL_BATCHES = {"count": 0}
+
+# Completed-operation wait durations, drained into the
+# tpu_provisioner_operation_wait_seconds histogram at scrape time. Bounded:
+# an operator whose /metrics is never scraped keeps only the newest samples
+# instead of growing one tuple per operation forever.
+_OPERATION_WAITS: list[tuple[str, float]] = []
+_MAX_WAIT_SAMPLES = 4096
+
+
+def record_operation_wait(kind: str, seconds: float) -> None:
+    _OPERATION_WAITS.append((kind, seconds))
+    if len(_OPERATION_WAITS) > _MAX_WAIT_SAMPLES:
+        del _OPERATION_WAITS[:len(_OPERATION_WAITS) - _MAX_WAIT_SAMPLES]
+
+
+def drain_operation_waits() -> list[tuple[str, float]]:
+    """Hand the accumulated (kind, seconds) samples to the scraper exactly
+    once each."""
+    global _OPERATION_WAITS
+    out, _OPERATION_WAITS = _OPERATION_WAITS, []
+    return out
+
+
+def _now() -> float:
+    # the loop clock inside async contexts (what every sleep is measured
+    # against); monotonic outside one (sync unit tests of the ladder)
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()
+
+
+# ------------------------------------------------------------ backoff ladder
+
+class BackoffLadder:
+    """Deadline + growing-interval poll ladder.
+
+    One home for the shape two call sites each hand-rolled: start at ``base``
+    seconds, grow ×``factor`` per step, cap at ``cap`` (default budget/4 —
+    a poll loop must get several looks within its own budget), jitter each
+    delay by up to ``jitter`` fraction, and expire at ``budget`` seconds
+    from construction. ``rng`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, budget: float, base: float, jitter: float = 0.0,
+                 factor: float = 1.5, cap: Optional[float] = None,
+                 rng: Callable[[], float] = random.random):
+        self.budget = budget
+        self.base = base
+        self.jitter = jitter
+        self.factor = factor
+        self.cap = cap if cap is not None else max(base, budget / 4)
+        self._rng = rng
+        self.interval = base
+        self.deadline = _now() + budget
+
+    def expired(self) -> bool:
+        return _now() >= self.deadline
+
+    def next_delay(self) -> float:
+        """The next sleep: current interval (jittered), then advance the
+        ladder. The returned delay is never above cap·(1+jitter)."""
+        delay = self.interval * (1 + self._rng() * self.jitter)
+        self.interval = min(self.interval * self.factor, self.cap)
+        return delay
+
+    def reset(self) -> None:
+        """Back to the base cadence (something changed; look closely again)."""
+        self.interval = self.base
+
+    async def sleep(self) -> None:
+        await asyncio.sleep(self.next_delay())
+
+
+# ------------------------------------------------------------- tracked ops
+
+@dataclass
+class TrackedOperation:
+    """One in-flight create/delete: the tracker's unit of work and the
+    caller-visible OperationPhase carrier."""
+
+    kind: str
+    name: str
+    hosts: int = 1
+    deadline: float = 0.0
+    started: float = 0.0
+    phase: str = PHASE_IN_PROGRESS
+    reason: str = ""
+    message: str = ""
+    wait_seconds: float = 0.0
+    completed_at: float = 0.0
+    done: asyncio.Event = field(default_factory=asyncio.Event)
+
+    @property
+    def in_progress(self) -> bool:
+        return self.phase == PHASE_IN_PROGRESS
+
+    @property
+    def succeeded(self) -> bool:
+        return self.phase == PHASE_SUCCEEDED
+
+
+class OperationTracker:
+    """The shared LRO multiplexer: one poller task, one batched
+    ``nodepools.list`` per tick, every in-flight operation resolved against
+    that snapshot.
+
+    ``nodepools`` is the provider's *counted* seam (so poll batches show up
+    in the per-endpoint cloud-call accounting) and ``kube`` the same
+    (informer-backed where wired) client the provider reads nodes through —
+    per-op node-wait checks are watch-cache maintenance, not apiserver
+    round-trips.
+
+    The poller idles (zero cloud calls) while no operation is registered,
+    wakes on registration, polls at ``interval``, and backs off ×1.5 up to
+    ``max_interval`` across ticks where nothing changed — a fleet-wide wave
+    polls at the base cadence exactly while state is moving. Each tick's
+    list call is bounded by ``poll_timeout`` so one hung cloud call cannot
+    wedge every operation behind it (the chaos hang profiles).
+
+    Terminal operations stay parked until their caller consumes them
+    (``pop``); ones with no returning caller are pruned after
+    ``TERMINAL_RETENTION`` seconds.
+    """
+
+    def __init__(self, nodepools, kube, interval: float = 1.0,
+                 max_interval: Optional[float] = None,
+                 jitter: float = 0.1,
+                 poll_timeout: Optional[float] = None):
+        self.nodepools = nodepools
+        self.kube = kube
+        self.interval = interval
+        self.max_interval = max_interval if max_interval is not None \
+            else interval * 8
+        self.jitter = jitter
+        self.poll_timeout = poll_timeout if poll_timeout is not None \
+            else max(10 * interval, 2.0)
+        self._ops: dict[str, TrackedOperation] = {}
+        self._subs: list[Callable[[TrackedOperation], Awaitable[None]]] = []
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        # observability (tests, /metrics sampling)
+        self.poll_batches = 0
+        self.poll_errors = 0
+        self.registered: dict[str, int] = {OP_CREATE: 0, OP_DELETE: 0}
+        self.completed_total = 0
+        TRACKERS.add(self)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(
+                self._run(), name=f"operation-tracker/{id(self):x}")
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    def task_alive(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    # --------------------------------------------------------- registration
+    def track_create(self, name: str, hosts: int,
+                     budget: float) -> TrackedOperation:
+        """Register (or return the already in-flight) create for ``name``.
+        A terminal op still parked under the name is replaced — the caller
+        that popped it acts exactly once; a caller that *didn't* pop simply
+        re-drives the wait."""
+        return self._track(OP_CREATE, name, hosts, budget)
+
+    def track_delete(self, name: str, budget: float) -> TrackedOperation:
+        """Register a delete for ``name``. Supersedes any create op under
+        the same name (delete wins — mirrors the cloud ledger)."""
+        return self._track(OP_DELETE, name, 0, budget)
+
+    # Parked terminal ops whose consumer never returns (a reaped claimless
+    # pool's delete has exactly one delete() call) are dropped after this
+    # many seconds — claim churn must not grow the op table forever.
+    TERMINAL_RETENTION = 600.0
+
+    def _prune_terminal(self) -> None:
+        cutoff = _now() - self.TERMINAL_RETENTION
+        for name, op in list(self._ops.items()):
+            if not op.in_progress and op.completed_at < cutoff:
+                del self._ops[name]
+
+    def _track(self, kind: str, name: str, hosts: int,
+               budget: float) -> TrackedOperation:
+        self._prune_terminal()
+        op = self._ops.get(name)
+        if op is not None and op.in_progress:
+            if op.kind == kind:
+                return op
+            if kind == OP_CREATE:
+                # a delete is in flight for the name; the create caller
+                # observes it via poke() — never displace a delete
+                return op
+            # delete supersedes create: complete the create as failed so a
+            # waiter blocked on op.done (create_and_wait) is released
+            self._complete(op, PHASE_FAILED, "Superseded",
+                           f"nodepool {name} create superseded by delete",
+                           notify=False)
+        op = TrackedOperation(kind=kind, name=name, hosts=hosts,
+                              started=_now(), deadline=_now() + budget)
+        self._ops[name] = op
+        self.registered[kind] += 1
+        self._wake.set()
+        return op
+
+    # ------------------------------------------------------------- queries
+    def poke(self, name: str) -> Optional[TrackedOperation]:
+        """Await-free phase snapshot (None if nothing tracked)."""
+        return self._ops.get(name)
+
+    def pop(self, name: str) -> Optional[TrackedOperation]:
+        """Consume a TERMINAL operation; in-flight ops stay put."""
+        op = self._ops.get(name)
+        if op is not None and not op.in_progress:
+            del self._ops[name]
+            return op
+        return None
+
+    def discard(self, name: str) -> None:
+        """Drop whatever is tracked under ``name``, any phase. For callers
+        that just proved the resource is GONE (pool 404 on the delete path):
+        nothing will ever consume the op, and an in-flight one would only
+        resolve to "vanished" next tick — parked entries must not accumulate
+        across claim churn."""
+        op = self._ops.pop(name, None)
+        if op is not None and op.in_progress:
+            self._complete(op, PHASE_FAILED, "Discarded",
+                           f"nodepool {name} is gone; operation discarded",
+                           notify=False)
+
+    def inflight(self) -> dict[str, int]:
+        counts = {OP_CREATE: 0, OP_DELETE: 0}
+        for op in self._ops.values():
+            if op.in_progress:
+                counts[op.kind] += 1
+        return counts
+
+    def subscribe(self, cb: Callable[[TrackedOperation],
+                                     Awaitable[None]]) -> None:
+        """Async ``cb(op)`` fired once per completed operation (the
+        workqueue-injection early-wake seam)."""
+        self._subs.append(cb)
+
+    # --------------------------------------------------------------- poller
+    async def _run(self) -> None:
+        ladder = BackoffLadder(float("inf"), self.interval,
+                               jitter=self.jitter, cap=self.max_interval)
+        while True:
+            if not any(op.in_progress for op in self._ops.values()):
+                self._wake.clear()
+                # idle: zero cloud calls until the next registration
+                await self._wake.wait()
+                ladder.reset()
+            # pace the next batched poll; a registration landing mid-sleep
+            # interrupts it and resets the cadence — new work must not wait
+            # out a backed-off interval for its first observation
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       timeout=ladder.next_delay())
+                ladder.reset()
+            except asyncio.TimeoutError:
+                pass
+            if await self._tick():
+                ladder.reset()
+
+    async def _tick(self) -> bool:
+        """One batched poll; resolves every in-flight op against it.
+        Returns True when any operation changed state."""
+        self.poll_batches += 1
+        POLL_BATCHES["count"] += 1
+        self._prune_terminal()
+        try:
+            pools = await asyncio.wait_for(self.nodepools.list(),
+                                           timeout=self.poll_timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — weather; deadlines still run
+            self.poll_errors += 1
+            log.debug("tracker poll failed (retrying next tick): %s", e)
+            return await self._enforce_deadlines()
+        by_name = {p.name: p for p in pools}
+        changed = False
+        for op in [o for o in self._ops.values() if o.in_progress]:
+            try:
+                if await self._resolve(op, by_name.get(op.name)):
+                    changed = True
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — per-op; next tick retries
+                log.debug("tracker resolve %s/%s failed: %s",
+                          op.kind, op.name, e)
+                if self._expire(op):
+                    changed = True
+        return changed
+
+    async def _enforce_deadlines(self) -> bool:
+        changed = False
+        for op in [o for o in self._ops.values() if o.in_progress]:
+            if self._expire(op):
+                changed = True
+        return changed
+
+    def _expire(self, op: TrackedOperation) -> bool:
+        if _now() < op.deadline:
+            return False
+        if op.kind == OP_DELETE:
+            self._complete(op, PHASE_FAILED, "DeleteTimeout",
+                           f"nodepool {op.name} still present after "
+                           f"{op.deadline - op.started:.0f}s delete wait")
+        else:
+            # retryable by convention: the consumer requeues and the retry's
+            # begin_create conflict re-registers (same contract the blocking
+            # adoption path had)
+            self._complete(op, PHASE_FAILED, "CreateInProgress",
+                           f"nodepool {op.name} operation still unresolved "
+                           f"after {op.deadline - op.started:.0f}s; requeueing")
+        return True
+
+    async def _resolve(self, op: TrackedOperation, pool) -> bool:
+        """Advance one op against the batched snapshot. True on completion."""
+        if op.kind == OP_DELETE:
+            if pool is None:
+                self._complete(op, PHASE_SUCCEEDED, "Deleted",
+                               f"nodepool {op.name} deleted")
+                return True
+            return self._expire(op)
+
+        # create
+        if pool is None:
+            self._complete(op, PHASE_FAILED, "CreateInProgress",
+                           f"nodepool {op.name} vanished while its create "
+                           "was in flight; requeueing")
+            return True
+        if pool.status == _NP_ERROR:
+            self._complete(op, PHASE_FAILED, "DegradedPool",
+                           f"nodepool {op.name} is ERROR: "
+                           f"{pool.status_message or 'unknown failure'}")
+            return True
+        if pool.status == _NP_STOPPING:
+            self._complete(op, PHASE_FAILED, "CreateInProgress",
+                           f"nodepool {op.name} is being deleted; requeueing")
+            return True
+        if pool.status == _NP_PROVISIONING:
+            return self._expire(op)
+        # RUNNING / RECONCILING: the LRO is done — now the node wait, off
+        # the (informer-backed) kube client: watch-cache maintenance, not a
+        # fresh apiserver LIST per op per tick
+        nodes = await self.kube.list(
+            Node, labels={wk.GKE_NODEPOOL_LABEL: op.name})
+        ready = sum(1 for n in nodes if n.spec.provider_id)
+        if ready >= op.hosts:
+            self._complete(op, PHASE_SUCCEEDED, "Created",
+                           f"nodepool {op.name} running with "
+                           f"{ready}/{op.hosts} nodes")
+            return True
+        if _now() >= op.deadline:
+            self._complete(op, PHASE_FAILED, "NodesNotReady",
+                           f"nodepool {op.name}: only {ready}/{op.hosts} "
+                           "nodes appeared with providerIDs before timeout")
+            return True
+        return False
+
+    def _complete(self, op: TrackedOperation, phase: str, reason: str,
+                  message: str, notify: bool = True) -> None:
+        op.phase, op.reason, op.message = phase, reason, message
+        op.completed_at = _now()
+        op.wait_seconds = op.completed_at - op.started
+        record_operation_wait(op.kind, op.wait_seconds)
+        self.completed_total += 1
+        op.done.set()
+        if not notify:
+            return
+        for cb in list(self._subs):
+            # fire-and-forget: a slow/broken subscriber must not stall the
+            # poll loop (the callback just injects a workqueue item)
+            asyncio.ensure_future(self._notify(cb, op))
+
+    @staticmethod
+    async def _notify(cb, op: TrackedOperation) -> None:
+        try:
+            await cb(op)
+        except Exception:  # noqa: BLE001 — observability-grade seam
+            log.warning("operation-tracker subscriber failed for %s/%s",
+                        op.kind, op.name, exc_info=True)

@@ -35,6 +35,12 @@ class Request:
 class Result:
     requeue: bool = False
     requeue_after: Optional[float] = None
+    # "Neither success nor failure": requeue via the success path (no
+    # rate-limit climb) but KEEP the item's failure history. Used for
+    # in-progress waits (a tracked create LRO) — without it, each wait lap
+    # forgets the counter and a persistently-failing create retries at a
+    # fixed cadence forever instead of climbing the backoff ladder.
+    preserve_failures: bool = False
 
 
 class Reconciler(Protocol):
@@ -124,6 +130,18 @@ class Controller:
         layer; this seam keeps the dependency pointing upward)."""
         self._exhausted_hook = hook
 
+    async def inject(self, name: str, namespace: str = "") -> None:
+        """External wake-up seam: enqueue a reconcile for ``name`` NOW.
+
+        Used by completion sources outside the watch stream — the operation
+        tracker injects a pool's request the tick its LRO resolves, so a
+        claim parked on ``Result(requeue_after=...)`` is reconciled
+        immediately instead of a full requeue interval later. Dedup and
+        processing-set semantics are the workqueue's own (an item mid-flight
+        is marked dirty and re-queued after ``done``), so a wake can never
+        be lost or duplicated into concurrent reconciles."""
+        await self.queue.add(Request(name=name, namespace=namespace))
+
     # -- run --------------------------------------------------------------
     async def _pump(self, client: Client, src: _Source) -> None:
         w = client.watch(src.cls)
@@ -169,7 +187,11 @@ class Controller:
             req = await self.queue.get()
             if self.fence is not None and not self.fence.valid():
                 # Deposed leader: single-writer discipline beats progress.
+                # Forget as well as done: a deposed-then-re-elected
+                # incarnation must not resume this item with a stale failure
+                # counter pinned at max backoff — the drop is not a failure.
                 self.fenced_total += 1
+                await self.queue.forget(req)
                 await self.queue.done(req)
                 continue
             start = time.monotonic()
@@ -208,7 +230,8 @@ class Controller:
                 await self.queue.done(req)
                 await self._requeue_failed(req)
             else:
-                await self.queue.forget(req)
+                if not (result and result.preserve_failures):
+                    await self.queue.forget(req)
                 await self.queue.done(req)
                 if result and result.requeue_after is not None:
                     await self.queue.add_after(req, result.requeue_after)

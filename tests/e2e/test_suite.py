@@ -265,16 +265,25 @@ async def test_multislice_group_provisions_n_slices(tmp_path):
         nodes = await env.eventually(coordinator_agreed,
                                      what="coordinator agreed on all nodes")
 
-        # every worker bootstraps jax.distributed args from labels alone
-        args_seen = []
-        for n in nodes:
-            topo = SliceTopology.from_node_labels(n.metadata.labels,
-                                                  environ={})
-            args = topo.distributed_init_args()
-            assert args["num_processes"] == 8
-            assert args["coordinator_address"] == f"gke-kaito-{pool0}-w0:8476"
-            args_seen.append(args["process_id"])
-        assert sorted(args_seen) == list(range(8))
+        # every worker bootstraps jax.distributed args from labels alone.
+        # Polled for the same reason as the indices/coordinator: a pool
+        # created off a momentarily-incomplete group view can be stamped a
+        # low num-slices, and the SliceGroupController repairs that label on
+        # the nodes a pass later — with non-blocking creates all four pools
+        # materialize at once, so the repair races this read.
+        async def bootstrap_args_converged():
+            args_seen = []
+            for n in await env._managed_nodes():
+                topo = SliceTopology.from_node_labels(n.metadata.labels,
+                                                      environ={})
+                args = topo.distributed_init_args()
+                if (args["num_processes"] != 8 or args["coordinator_address"]
+                        != f"gke-kaito-{pool0}-w0:8476"):
+                    return None
+                args_seen.append(args["process_id"])
+            return args_seen if sorted(args_seen) == list(range(8)) else None
+        await env.eventually(bootstrap_args_converged,
+                             what="jax.distributed bootstrap args converged")
 
 
 @fake_only

@@ -4,7 +4,8 @@ tier-1 gate, run via ``make bench`` / ``pytest -m bench``)."""
 import pytest
 
 from bench.bench_provision import (
-    bench_gc_pass, check_budget, make_budget,
+    bench_constrained_wave, bench_gc_pass, check_budget, check_pr04_budget,
+    make_budget, make_pr04_budget,
 )
 
 from .conftest import async_test
@@ -31,6 +32,40 @@ async def test_gc_pass_fast_path_beats_legacy():
 async def test_gc_pass_reaps_nothing_during_measurement():
     out = await bench_gc_pass(5, legacy=False)
     assert out["pools"] == 5  # asserted inside the harness too
+
+
+@async_test
+async def test_constrained_wave_tracker_beats_blocking():
+    """PR 4's headline at smoke scale: with workers squeezed, the tracker
+    wave wins wall clock, pins far fewer worker-seconds, and issues ZERO
+    client-side LRO polls (the blocking baseline polls per operation)."""
+    before = await bench_constrained_wave(12, workers=4, blocking=True,
+                                          create_latency=0.2)
+    after = await bench_constrained_wave(12, workers=4, blocking=False,
+                                         create_latency=0.2)
+    assert before["poll_calls"]["operation_poll"] > 0
+    assert after["poll_calls"]["operation_poll"] == 0
+    assert before["ready_wall_s"] > after["ready_wall_s"]
+    assert (before["pinned_worker_seconds_total"]
+            > after["pinned_worker_seconds_total"])
+    assert before["leaked_pools"] == after["leaked_pools"] == 0
+
+
+def test_pr04_budget_check_flags_regression_and_passes_clean():
+    recorded = {"budget": {"constrained_wave_poll_calls": 600,
+                           "constrained_wave_pinned_worker_seconds": 6.0}}
+    bad = {"after": {"poll_calls_total": 4000,
+                     "pinned_worker_seconds_total": 90.0}}
+    violations = check_pr04_budget(bad, recorded)
+    assert any("poll calls" in v for v in violations)
+    assert any("pinned-worker-seconds" in v for v in violations)
+
+    good = {"after": {"poll_calls_total": 200,
+                      "pinned_worker_seconds_total": 2.0}}
+    assert check_pr04_budget(good, recorded) == []
+    derived = make_pr04_budget(good)
+    assert derived["constrained_wave_poll_calls"] == 600
+    assert derived["constrained_wave_pinned_worker_seconds"] == 6.0
 
 
 def test_budget_check_flags_regression_and_passes_clean():

@@ -137,8 +137,10 @@ async def test_node_delete_triggers_drain_condition():
 @async_test
 async def test_instance_gc_reaps_leaked_pool():
     async with Env() as env:
-        # create through the provider directly — no NodeClaim backs the pool
-        await env.provider.create(make_nodeclaim("leak"))
+        # create through the provider directly — no NodeClaim backs the
+        # pool (create_and_wait: the blocking driver over the resumable
+        # create state machine, for direct use with no reconciler)
+        await env.provider.create_and_wait(make_nodeclaim("leak"))
         assert "leak" in env.cloud.nodepools.pools
         deadline = asyncio.get_event_loop().time() + 5
         while "leak" in env.cloud.nodepools.pools:
@@ -165,7 +167,7 @@ async def test_gc_holds_off_on_stale_informer_cache():
                           use_informer=True)
     async with Env(opts) as env:
         loop = asyncio.get_event_loop()
-        await env.provider.create(make_nodeclaim("leak"))
+        await env.provider.create_and_wait(make_nodeclaim("leak"))
         # wedge: stop the pumps (no events, no re-lists) but keep serving
         # the cache, and stamp it ancient
         for inf in env.informers._informers.values():
@@ -497,8 +499,16 @@ async def test_blocked_eviction_warns_then_drains_when_pdb_lifted():
 
         await env.client.delete(PodDisruptionBudget, "inf-pdb", "default")
         await env.wait_gone("ws0", timeout=15.0)
-        with pytest.raises(NotFoundError):
-            await env.client.get(Pod, "inference", "default")
+
+        # the unblocked eviction lands on the queue's next backoff retry —
+        # poll rather than racing the retry ladder's phase
+        async def evicted():
+            try:
+                await env.client.get(Pod, "inference", "default")
+                return None
+            except NotFoundError:
+                return True
+        await _poll(evicted, timeout=15.0, what="pod evicted after PDB lift")
         assert env.cloud.nodepools.pools == {}
 
 

@@ -1,0 +1,446 @@
+"""Non-blocking provisioning: the operation tracker (LRO multiplexer), the
+shared BackoffLadder, the resumable create/delete state machine, and the
+lifecycle integration (requeue_after + tracker-completion early wake).
+
+The PR 4 contract under test:
+
+- ``InstanceProvider.create()/delete()`` with a tracker never park the
+  caller: they register the LRO and raise/return immediately; the tracker's
+  single poller drives every wait off ONE batched ``nodepools.list`` per
+  tick (zero per-op ``nodepools.get`` polls, zero client-side LRO polls);
+- the lifecycle controller turns ``CreateInProgress`` into
+  ``Result(requeue_after=...)`` — no failure counters, no backoff climb —
+  and converges with ``reconcile_timeout`` set far below a slice-create
+  duration (the acceptance criterion the blocking shape made impossible);
+- the tracker's poller task never outlives its Env (teardown gate).
+"""
+
+import asyncio
+
+import pytest
+
+from gpu_provisioner_tpu.apis.core import Node
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+from gpu_provisioner_tpu.errors import CreateError, NodeClaimNotFoundError
+from gpu_provisioner_tpu.fake import FakeCloud, make_nodeclaim
+from gpu_provisioner_tpu.providers.instance import (
+    InstanceProvider, ProviderConfig,
+)
+from gpu_provisioner_tpu.providers.operations import (
+    OP_CREATE, OP_DELETE, PHASE_FAILED, PHASE_IN_PROGRESS, PHASE_SUCCEEDED,
+    BackoffLadder, OperationTracker,
+)
+from gpu_provisioner_tpu.runtime import InMemoryClient
+
+from .conftest import async_test
+
+
+# ------------------------------------------------------------ BackoffLadder
+
+def test_ladder_growth_caps_at_quarter_budget():
+    ladder = BackoffLadder(budget=40.0, base=1.0, rng=lambda: 0.0)
+    delays = [ladder.next_delay() for _ in range(10)]
+    # ×1.5 growth from base, hard-capped at budget/4
+    assert delays[0] == 1.0
+    assert delays[1] == 1.5
+    assert max(delays) == 10.0 == ladder.cap
+    assert delays[-1] == 10.0  # stays pinned at the cap
+
+
+def test_ladder_jitter_bounds_and_determinism():
+    top = BackoffLadder(budget=8.0, base=1.0, jitter=0.5, rng=lambda: 1.0)
+    bottom = BackoffLadder(budget=8.0, base=1.0, jitter=0.5, rng=lambda: 0.0)
+    # jitter stretches a delay by at most (1 + jitter); never shrinks it
+    assert top.next_delay() == 1.5
+    assert bottom.next_delay() == 1.0
+    # jitter applies to the delay only — the ladder position is unaffected
+    assert top.interval == bottom.interval == 1.5
+
+
+def test_ladder_cap_never_below_base():
+    # a tiny budget must not produce a cap under the base interval (the
+    # old inline ladders had the same budget/4 floor implicitly via min())
+    ladder = BackoffLadder(budget=0.1, base=1.0, rng=lambda: 0.0)
+    assert ladder.cap == 1.0
+    assert ladder.next_delay() == 1.0
+
+
+@async_test
+async def test_ladder_reset_and_expiry():
+    ladder = BackoffLadder(budget=0.05, base=0.01, rng=lambda: 0.0)
+    assert not ladder.expired()
+    ladder.next_delay()
+    ladder.next_delay()
+    assert ladder.interval > 0.01
+    ladder.reset()
+    assert ladder.interval == 0.01
+    await asyncio.sleep(0.06)
+    assert ladder.expired()
+
+
+# --------------------------------------------------------- tracker plumbing
+
+def _provider(cloud, kube, tracker=None, **cfg_kw):
+    cfg = ProviderConfig(node_wait_interval=0.02, node_wait_attempts=30,
+                        cache_ttl=0.0, **cfg_kw)
+    return InstanceProvider(cloud.nodepools, kube, cfg,
+                            queued=cloud.queuedresources, tracker=tracker)
+
+
+async def _tracked_env(create_latency=0.05, interval=0.02):
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=create_latency,
+                      delete_latency=0.03)
+    provider = _provider(cloud, kube)
+    tracker = OperationTracker(provider.nodepools, kube, interval=interval)
+    provider.tracker = tracker
+    tracker.start()
+    return kube, cloud, provider, tracker
+
+
+@async_test
+async def test_tracker_idles_without_operations():
+    kube, cloud, provider, tracker = await _tracked_env()
+    try:
+        await asyncio.sleep(0.15)
+        assert tracker.poll_batches == 0, \
+            "an idle tracker must issue zero cloud polls"
+        assert cloud.nodepools.calls.get("list", 0) == 0
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_create_registers_then_completes_via_batched_list():
+    kube, cloud, provider, tracker = await _tracked_env()
+    try:
+        nc = make_nodeclaim("op0", "tpu-v5e-8")
+        with pytest.raises(CreateError) as ei:
+            await provider.create(nc)
+        assert ei.value.reason == "CreateInProgress"
+        op = tracker.poke("op0")
+        assert op is not None and op.kind == OP_CREATE
+        assert op.phase == PHASE_IN_PROGRESS
+        assert tracker.inflight() == {OP_CREATE: 1, OP_DELETE: 0}
+
+        # a re-driven reconcile while in flight: zero additional cloud calls
+        begin_creates = cloud.nodepools.calls["begin_create"]
+        gets = cloud.nodepools.calls["get"]
+        with pytest.raises(CreateError):
+            await provider.create(nc)
+        assert cloud.nodepools.calls["begin_create"] == begin_creates
+        assert cloud.nodepools.calls["get"] == gets
+
+        await asyncio.wait_for(op.done.wait(), 5)
+        assert op.phase == PHASE_SUCCEEDED
+        inst = await provider.create(nc)   # consumes the tracked outcome
+        assert inst.name == "op0" and inst.state == "Succeeded"
+        assert inst.node_provider_ids, "nodes must be up before completion"
+        assert tracker.poke("op0") is None, "terminal op must be consumed"
+        # the multiplexed wait never polled per-op: no nodepools.get (one
+        # final get reads the created pool), no client-side LRO polls
+        assert cloud.nodepools.calls.get("operation_poll", 0) == 0
+        assert cloud.nodepools.calls["get"] <= 1
+        assert tracker.poll_batches >= 1
+        assert cloud.nodepools.calls["list"] == tracker.poll_batches
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_blocking_baseline_polls_per_operation():
+    """The shape the tracker replaces (and the bench baseline): a
+    tracker-less provider still blocks and polls its own LRO."""
+    kube = InMemoryClient()
+    cloud = FakeCloud(kube, create_latency=0.05)
+    provider = _provider(cloud, kube)
+    inst = await provider.create(make_nodeclaim("bl0", "tpu-v5e-8"))
+    assert inst.state == "Succeeded"
+    assert cloud.nodepools.calls["operation_poll"] >= 1
+
+
+@async_test
+async def test_nonblocking_delete_registers_and_reports_gone():
+    kube, cloud, provider, tracker = await _tracked_env()
+    try:
+        await provider.create_and_wait(make_nodeclaim("del0", "tpu-v5e-8"))
+        await provider.delete("del0")          # begin_delete + register
+        op = tracker.poke("del0")
+        assert op is not None and op.kind == OP_DELETE
+        assert "del0" in cloud.nodepools.pools  # LRO not settled yet
+
+        gets = cloud.nodepools.calls["get"]
+        await provider.delete("del0")          # "still terminating"
+        assert cloud.nodepools.calls["get"] == gets, \
+            "an in-flight tracked delete must not re-read the pool"
+
+        await asyncio.wait_for(op.done.wait(), 5)
+        assert op.phase == PHASE_SUCCEEDED
+        with pytest.raises(NodeClaimNotFoundError):
+            await provider.delete("del0")      # consumes the outcome
+        assert "del0" not in cloud.nodepools.pools
+        assert cloud.nodepools.calls.get("operation_poll", 0) == 0
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_delete_supersedes_inflight_create():
+    kube, cloud, provider, tracker = await _tracked_env(create_latency=0.3)
+    try:
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("sup0", "tpu-v5e-8"))
+        create_op = tracker.poke("sup0")
+        assert create_op.kind == OP_CREATE
+        await provider.delete("sup0")
+        op = tracker.poke("sup0")
+        assert op.kind == OP_DELETE and op.in_progress
+        # the displaced create resolved (a create_and_wait waiter wakes)
+        assert create_op.phase == PHASE_FAILED
+        assert create_op.reason == "Superseded"
+        await asyncio.wait_for(op.done.wait(), 5)
+        assert "sup0" not in cloud.nodepools.pools
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_tracker_deadline_fails_op_retryably():
+    kube, cloud, provider, tracker = await _tracked_env(create_latency=60.0)
+    try:
+        # budget at this config: 2 × 30 × 0.02 = 1.2s ≪ the 60s "LRO"
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("slow0", "tpu-v5e-8"))
+        op = tracker.poke("slow0")
+        await asyncio.wait_for(op.done.wait(), 10)
+        assert op.phase == PHASE_FAILED
+        assert op.reason == "CreateInProgress", \
+            "deadline expiry must stay retryable (requeue + re-adopt)"
+        with pytest.raises(CreateError) as ei:
+            await provider.create(make_nodeclaim("slow0", "tpu-v5e-8"))
+        assert ei.value.reason == "CreateInProgress"
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_tracker_completion_notifies_subscribers():
+    kube, cloud, provider, tracker = await _tracked_env()
+    completed = []
+
+    async def on_complete(op):
+        completed.append((op.kind, op.name, op.phase))
+
+    tracker.subscribe(on_complete)
+    try:
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("sub0", "tpu-v5e-8"))
+        op = tracker.poke("sub0")
+        await asyncio.wait_for(op.done.wait(), 5)
+        await asyncio.sleep(0)  # let the fire-and-forget callback land
+        assert (OP_CREATE, "sub0", PHASE_SUCCEEDED) in completed
+        assert op.wait_seconds > 0
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_delete_of_vanished_pool_discards_parked_op():
+    """Claim churn hygiene: when delete() proves the pool is gone, any op
+    parked under the name is discarded — terminal ops whose claim died must
+    not accumulate in the tracker forever."""
+    kube, cloud, provider, tracker = await _tracked_env(create_latency=0.3)
+    try:
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("van0", "tpu-v5e-8"))
+        assert tracker.poke("van0") is not None
+        # out-of-band teardown: the pool disappears without our delete LRO
+        cloud.nodepools.pools.pop("van0")
+        cloud.nodepools._pending.pop("van0", None)
+        with pytest.raises(NodeClaimNotFoundError):
+            await provider.delete("van0")
+        assert tracker.poke("van0") is None, \
+            "a parked op for a proven-gone pool must be discarded"
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_reused_name_after_reaped_delete_is_not_wedged():
+    """Regression: GC/recovery reap a claimless pool through delete() and
+    never call delete() again — the resolved delete op sits parked under
+    the name with no consumer. A NodeClaim reusing that name (KAITO
+    recreating a workspace) must pop it and provision fresh, not see
+    "being deleted" forever."""
+    kube, cloud, provider, tracker = await _tracked_env()
+    try:
+        await provider.create_and_wait(make_nodeclaim("ru0", "tpu-v5e-8"))
+        await provider.delete("ru0")               # the reap: exactly one call
+        op = tracker.poke("ru0")
+        await asyncio.wait_for(op.done.wait(), 5)
+        assert op.phase == PHASE_SUCCEEDED
+        # nobody consumed the outcome; a new claim reuses the name
+        inst = await provider.create_and_wait(
+            make_nodeclaim("ru0", "tpu-v5e-8"), timeout=10)
+        assert inst.state == "Succeeded"
+        assert "ru0" in cloud.nodepools.pools
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_persistent_create_failure_still_climbs_backoff_ladder():
+    """Regression: the CreateInProgress lap rides the success path but must
+    PRESERVE failure history (Result.preserve_failures) — a pool that lands
+    ERROR on every create alternates fail → re-register, and forgetting the
+    counter each lap would pin its begin_create cadence flat forever."""
+    from gpu_provisioner_tpu import chaos
+    from gpu_provisioner_tpu.runtime import Request
+
+    policy = chaos.ChaosPolicy(3, partial={"op_error": 1.0})
+    opts = EnvtestOptions(chaos=policy, create_latency=0.03)
+    opts.lifecycle.launch_timeout = 600.0  # liveness must not end the test
+    async with Env(opts) as env:
+        await env.client.create(make_nodeclaim("err0", "tpu-v5e-8"))
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        req = Request(name="err0")
+        deadline = asyncio.get_event_loop().time() + 8
+        while lifecycle.queue.num_requeues(req) < 3:
+            assert asyncio.get_event_loop().time() < deadline, \
+                "failure counter never climbed across in-progress laps"
+            await asyncio.sleep(0.05)
+
+
+@async_test
+async def test_track_create_is_idempotent():
+    kube, cloud, provider, tracker = await _tracked_env(create_latency=0.3)
+    try:
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("idem0", "tpu-v5e-8"))
+        op1 = tracker.poke("idem0")
+        op2 = tracker.track_create("idem0", 1, 10.0)
+        assert op1 is op2, "re-registering an in-flight create is a no-op"
+        assert tracker.registered[OP_CREATE] == 1
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_create_and_wait_drives_state_machine():
+    kube, cloud, provider, tracker = await _tracked_env()
+    try:
+        inst = await provider.create_and_wait(
+            make_nodeclaim("caw0", "tpu-v5e-8"), timeout=10)
+        assert inst.state == "Succeeded"
+    finally:
+        await tracker.stop()
+
+
+@async_test
+async def test_tracker_poll_errors_still_enforce_deadlines():
+    """A dead cloud (every list fails) must not wedge tracked ops past
+    their deadlines — the deadline check runs on the error path too."""
+    from gpu_provisioner_tpu.providers.gcp import APIError
+
+    kube, cloud, provider, tracker = await _tracked_env(create_latency=60.0)
+    try:
+        with pytest.raises(CreateError):
+            await provider.create(make_nodeclaim("dead0", "tpu-v5e-8"))
+        cloud.nodepools.fail("list", APIError("outage", code=503), times=10_000)
+        op = tracker.poke("dead0")
+        await asyncio.wait_for(op.done.wait(), 10)
+        assert op.phase == PHASE_FAILED
+        assert tracker.poll_errors >= 1
+    finally:
+        await tracker.stop()
+
+
+# ------------------------------------------------- lifecycle integration
+
+@async_test
+async def test_lifecycle_converges_with_reconcile_timeout_below_create():
+    """The acceptance criterion PR 4 exists for: with creates taking 0.5s,
+    a 0.15s per-reconcile deadline — impossible under the blocking shape,
+    where one create pinned a worker for the whole duration — converges
+    cleanly, and the deadline never fires."""
+    opts = EnvtestOptions(create_latency=0.5, node_ready_delay=0.05,
+                          reconcile_timeout=0.15)
+    async with Env(opts) as env:
+        await env.client.create(make_nodeclaim("fast0", "tpu-v5e-8"))
+        nc = await env.wait_ready("fast0", timeout=15)
+        assert nc.status.provider_id
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        assert lifecycle.timeouts_total == 0, \
+            "non-blocking reconciles must fit far inside the deadline"
+        # in-progress requeues ride the success path: no failure counters
+        assert lifecycle.queue.retrying() == 0
+
+
+@async_test
+async def test_inprogress_wave_does_not_climb_backoff_ladder():
+    """CreateInProgress is progress, not failure: while an op is in flight
+    the claim's workqueue failure counter stays at zero (the error path
+    would climb the exponential ladder and stretch every wave)."""
+    opts = EnvtestOptions(create_latency=0.4)
+    async with Env(opts) as env:
+        await env.client.create(make_nodeclaim("wv0", "tpu-v5e-8"))
+        lifecycle = next(c for c in env.manager.controllers
+                         if c.name == "nodeclaim.lifecycle")
+        deadline = asyncio.get_event_loop().time() + 0.35
+        while asyncio.get_event_loop().time() < deadline:
+            assert lifecycle.queue.retrying() == 0
+            await asyncio.sleep(0.02)
+        await env.wait_ready("wv0", timeout=15)
+
+
+@async_test
+async def test_env_teardown_reaps_tracker_task():
+    opts = EnvtestOptions()
+    env = Env(opts)
+    async with env:
+        assert env.tracker is not None
+        assert env.tracker.task_alive()
+    assert not env.tracker.task_alive(), \
+        "the tracker poller must die with its Env"
+    leaked = [t for t in asyncio.all_tasks()
+              if t.get_name().startswith("operation-tracker")
+              and not t.done()]
+    assert not leaked, f"leaked tracker tasks: {leaked}"
+
+
+@async_test
+async def test_blocking_create_option_restores_baseline_shape():
+    opts = EnvtestOptions(blocking_create=True)
+    async with Env(opts) as env:
+        assert env.tracker is None and env.provider.tracker is None
+        await env.client.create(make_nodeclaim("bc0", "tpu-v5e-8"))
+        await env.wait_ready("bc0", timeout=15)
+        assert env.cloud.nodepools.calls["operation_poll"] >= 1, \
+            "the baseline must still poll its LROs client-side"
+
+
+# ------------------------------------------------------------------ metrics
+
+@async_test
+async def test_operation_metrics_sampled_at_scrape():
+    from gpu_provisioner_tpu.controllers.metrics import (
+        INFLIGHT_OPERATIONS, OPERATION_POLL_BATCHES, OPERATION_WAIT,
+        update_runtime_gauges,
+    )
+
+    opts = EnvtestOptions()
+    async with Env(opts) as env:
+        await env.client.create(make_nodeclaim("mx0", "tpu-v5e-8"))
+        await env.wait_ready("mx0", timeout=15)
+        waits0 = OPERATION_WAIT.labels("create")._sum.get()
+        update_runtime_gauges(env.manager)
+        assert OPERATION_POLL_BATCHES._value.get() >= env.tracker.poll_batches
+        assert OPERATION_WAIT.labels("create")._sum.get() > waits0, \
+            "completed create duration must land in the histogram"
+        # steady state: THIS env's tracker has nothing in flight (the gauge
+        # itself aggregates every live tracker in the process — other
+        # tests' not-yet-collected trackers may contribute)
+        assert env.tracker.inflight() == {"create": 0, "delete": 0}
+        assert INFLIGHT_OPERATIONS.labels("create")._value.get() >= 0

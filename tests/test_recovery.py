@@ -250,6 +250,65 @@ async def test_fake_cloud_drives_lros_server_side():
     assert pools == [] and await kube.list(Node) == []
 
 
+# ------------------------------------------- crash points × operation tracker
+
+# The PR 3 cut lines whose stranded state is an in-flight LRO — after a
+# restart the new incarnation must RE-REGISTER that LRO with its operation
+# tracker (recovery resync resume_create / conflict adoption / STOPPING
+# delete adoption) and converge through batched polling, never a blind
+# blocking wait.
+TRACKER_MATRIX = [
+    ("mid-create", "after_pool_begin_create"),
+    ("mid-create", "before_lro_done"),
+    ("mid-delete", "mid_delete_after_pool_delete"),
+]
+
+
+@pytest.mark.parametrize("scenario,point", TRACKER_MATRIX)
+@async_test
+async def test_crash_restart_reregisters_lro_with_tracker(scenario, point):
+    from gpu_provisioner_tpu.providers.operations import OP_CREATE, OP_DELETE
+
+    crashes = chaos.CrashPoints(seed=SEED)
+    # a slow delete LRO so the restarted incarnation genuinely observes the
+    # stranded delete mid-flight (STOPPING) instead of finding it settled
+    opts = _opts(crashes=crashes,
+                 delete_latency=1.0 if scenario == "mid-delete" else 0.02)
+    renv = RestartableEnv(opts)
+    await renv.start()
+    try:
+        if scenario == "mid-delete":
+            await renv.client.create(make_nodeclaim("tr0"))
+            await renv.wait_ready("tr0", timeout=25)
+            crashes.arm(point)
+            await renv.client.delete(NodeClaim, "tr0")
+        else:
+            crashes.arm(point)
+            await renv.client.create(make_nodeclaim("tr0"))
+        await asyncio.wait_for(crashes.crashed.wait(), 20)
+
+        env2 = await renv.restart()
+        kind = OP_DELETE if scenario == "mid-delete" else OP_CREATE
+        deadline = asyncio.get_event_loop().time() + 15
+        while env2.tracker.registered[kind] < 1:
+            assert asyncio.get_event_loop().time() < deadline, \
+                f"stranded {kind} LRO never re-registered with the tracker"
+            await asyncio.sleep(0.02)
+
+        if scenario == "mid-delete":
+            await renv.wait_gone("tr0", timeout=25)
+            await _assert_no_leaks(renv, set())
+        else:
+            await renv.wait_ready("tr0", timeout=30)
+            await _assert_no_leaks(renv, {"tr0"})
+        # the whole scenario — both incarnations — must never have polled
+        # an LRO client-side: resumption went through the multiplexer, not
+        # a blind node wait/poll loop
+        assert renv.cloud.nodepools.calls.get("operation_poll", 0) == 0
+    finally:
+        await renv.crash()
+
+
 # -------------------------------------------------------- fenced failover
 
 FAST = dict(lease_duration=2.0, renew_interval=0.4, retry_interval=0.1)

@@ -212,6 +212,61 @@ async def test_controller_error_retries_with_backoff():
         await mgr.stop()
 
 
+class _Fence:
+    def __init__(self, valid=False):
+        self._valid = valid
+
+    def valid(self):
+        return self._valid
+
+
+@async_test
+async def test_fenced_dequeue_forgets_failure_counter():
+    """Regression: the fenced drop path called queue.done but never
+    queue.forget, so a deposed-then-re-elected incarnation resumed items
+    with stale failure counters pinned at max backoff. A fenced drop is
+    not a failure — the counter must clear."""
+    c = InMemoryClient()
+    r = CountingReconciler()
+    ctrl = Controller("test", r).watches(NodeClaim)
+    ctrl.queue.base_delay = 0.001
+    ctrl.fence = _Fence(valid=False)
+    req = Request(name="x")
+    # the item arrives carrying failure history from before deposition
+    for _ in range(5):
+        await ctrl.queue.add_rate_limited(req)
+    assert ctrl.queue.num_requeues(req) == 5
+    mgr = Manager(c).register(ctrl)
+    await mgr.start()
+    try:
+        await eventually(lambda: ctrl.fenced_total >= 1)
+        await eventually(lambda: ctrl.queue.num_requeues(req) == 0)
+        assert r.seen == [], "a fenced worker must not reconcile"
+        # re-election: the item reconciles with a clean slate
+        ctrl.fence = _Fence(valid=True)
+        await ctrl.queue.add(req)
+        await eventually(lambda: req in r.seen)
+        assert ctrl.queue.num_requeues(req) == 0
+    finally:
+        await mgr.stop()
+
+
+@async_test
+async def test_controller_inject_wakes_reconcile():
+    """The tracker-completion early-wake seam: inject() enqueues a request
+    outside the watch stream, with workqueue dedup semantics."""
+    c = InMemoryClient()
+    r = CountingReconciler()
+    ctrl = Controller("test", r).watches(NodeClaim)
+    mgr = Manager(c).register(ctrl)
+    await mgr.start()
+    try:
+        await ctrl.inject("woken")
+        await eventually(lambda: any(s.name == "woken" for s in r.seen))
+    finally:
+        await mgr.stop()
+
+
 @async_test
 async def test_singleton_self_requeues():
     runs = []
