@@ -37,6 +37,10 @@ chaos: ## Chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_M
 recover: ## Crash-restart recovery soaks: crash-point matrix + fenced leader failover
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_recovery.py -q -m recovery
 
+.PHONY: repair
+repair: ## Node-fault health soaks: fault-profile × workload matrix + repair regressions
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_health.py -q -m repair
+
 .PHONY: e2etests-real
 e2etests-real: ## Same specs against a live cluster (suite_test.go:34-45 mode).
 	## Prereqs: operator deployed (make helm-install), KUBECONFIG pointing at

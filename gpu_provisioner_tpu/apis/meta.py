@@ -58,6 +58,11 @@ class Condition:
     reason: str = ""
     message: str = ""
     last_transition_time: Optional[datetime] = None
+    # NodeCondition's kubelet liveness signal (core/v1): refreshed on every
+    # kubelet status report even when the status value is unchanged. A
+    # heartbeat that stops while ``status`` stays a stale ``True`` is the
+    # silent-kubelet-death signature node repair keys off.
+    last_heartbeat_time: Optional[datetime] = None
     observed_generation: int = 0
 
 

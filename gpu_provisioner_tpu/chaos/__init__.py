@@ -1,15 +1,23 @@
 """Chaos-injection subsystem: seeded fault policies for the simulated cloud
-and kube client, the named profiles the soak suite runs under, and the
-crash-point schedule the crash-restart recovery suite drives."""
+and kube client, the named profiles the soak suite runs under, the
+crash-point schedule the crash-restart recovery suite drives, and the
+node-fault injector that makes Nodes themselves sick (flapping Ready,
+degraded accelerators, silent kubelet death, maintenance waves)."""
 
 from .client import ChaosClient, ChaosClientError, transient_kube
 from .crash import CRASH_POINTS, CrashPoints, SimulatedCrash
+from .nodefaults import (
+    ACCELERATOR_HEALTHY, FAULT_KINDS, MAINTENANCE_SCHEDULED,
+    NODE_FAULT_PROFILES, NodeFault, NodeFaultInjector, node_fault_profile,
+)
 from .policy import (
     ChaosPolicy, FaultRule, PROFILES, profile, stockout, transient,
 )
 
 __all__ = [
-    "CRASH_POINTS", "ChaosClient", "ChaosClientError", "ChaosPolicy",
-    "CrashPoints", "FaultRule", "PROFILES", "SimulatedCrash", "profile",
-    "stockout", "transient", "transient_kube",
+    "ACCELERATOR_HEALTHY", "CRASH_POINTS", "ChaosClient", "ChaosClientError",
+    "ChaosPolicy", "CrashPoints", "FAULT_KINDS", "FaultRule",
+    "MAINTENANCE_SCHEDULED", "NODE_FAULT_PROFILES", "NodeFault",
+    "NodeFaultInjector", "PROFILES", "SimulatedCrash", "node_fault_profile",
+    "profile", "stockout", "transient", "transient_kube",
 ]

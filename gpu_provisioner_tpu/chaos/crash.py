@@ -41,6 +41,9 @@ CRASH_POINTS = (
     "mid_delete_after_pool_delete",
     # node tainted, evictions queued in-memory, drain unfinished
     "mid_drain",
+    # repair committed: node cordoned, budget token consumed (in-memory),
+    # evictions queued — the NodeClaim force-delete not yet issued
+    "mid_repair",
 )
 
 

@@ -1,0 +1,302 @@
+"""Node-fault injection: the third chaos leg — sick *nodes*, not sick APIs.
+
+PR 1 faults the cloud API (``ChaosPolicy``) and PR 3 kills the operator
+(``CrashPoints``); nothing could produce an unhealthy Node, which for
+multi-host TPU slices is the dominant real failure (one bad host breaks the
+ICI ring and strands the whole slice). ``NodeFaultInjector`` plays the
+kubelet fleet: a seeded background task that drives Node *state* over
+envtest time — ``Ready`` flapping, accelerator degradation, silent kubelet
+death (heartbeats stop while ``Ready`` stays stale-True), and scheduled
+maintenance notices — through the ``fake.builders`` condition helpers, so
+every fault writes conditions exactly the way a kubelet would.
+
+Determinism follows the ``ChaosPolicy`` convention: whether a node is a
+fault's victim is a pure hash of ``(seed, kind, node name)``, independent of
+scheduling order; fault *timing* is anchored per node NAME at the moment the
+injector first observes it (monotonic), and the clock survives repair
+replacements under the same name — a finite-duration fault's window closes
+in wall time no matter how many replacements appear inside it (a
+replacement created inside the window is re-faulted, one created after it
+stays clean), which is what lets the repair soaks converge.
+
+The injector doubles as the heartbeat source: real clusters have a
+node-lifecycle-controller marking silent nodes ``Unknown``; envtest doesn't,
+so repair's stale-heartbeat policy (controllers/health.py) needs live nodes
+to actually *have* fresh heartbeats. Every tick stamps
+``Ready.lastHeartbeatTime`` on managed nodes except silent-death victims.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field, replace
+from fnmatch import fnmatch
+from typing import Callable, Optional
+
+from ..apis import labels as wk
+from ..apis.core import Node
+from ..fake.builders import heartbeat_node, set_node_condition, set_node_ready
+
+log = logging.getLogger("chaos.nodefaults")
+
+# Condition types the repair policies key off (cloudprovider/tpu.py).
+ACCELERATOR_HEALTHY = "AcceleratorHealthy"
+MAINTENANCE_SCHEDULED = "MaintenanceScheduled"
+
+FAULT_KINDS = ("flap", "degrade", "silent", "maintenance")
+
+
+@dataclass
+class NodeFault:
+    """One node-state fault, matched by ``fnmatch`` against node names.
+
+    ``rate`` is the seeded per-node probability that a matched node is a
+    victim (1.0 = every match). The fault is active from ``start`` to
+    ``start + duration`` seconds after the injector FIRST OBSERVES the node's
+    name (the clock is shared by same-named repair replacements, so a finite
+    window closes in wall time); outside the window the injector heals what
+    it broke.
+
+    Kinds:
+
+    - ``flap``         Ready oscillates True/False every ``period`` seconds,
+                       resetting lastTransitionTime on each flip — each
+                       individual False interval is shorter than any sane
+                       toleration, which is exactly the repair-defeating
+                       shape the hysteresis window exists for.
+    - ``degrade``      ``AcceleratorHealthy=False`` (device-plugin-reported
+                       accelerator fault), stable for the window.
+    - ``silent``       heartbeats stop; ``Ready`` stays a stale ``True`` —
+                       no watch event will ever announce this death.
+    - ``maintenance``  ``MaintenanceScheduled=True`` notice for the window.
+    """
+
+    kind: str
+    match: str = "*"
+    rate: float = 1.0
+    start: float = 0.0
+    period: float = 0.5
+    duration: float = float("inf")
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown node fault kind {self.kind!r}; known: {FAULT_KINDS}")
+
+
+class NodeFaultInjector:
+    """Seeded kubelet-fleet simulator driving Node conditions over time.
+
+    ``start(client)`` binds a kube client (the RAW envtest client — faults
+    are the world's doing and must not themselves be subject to kube chaos)
+    and launches the tick loop; idempotent, so a ``RestartableEnv`` can
+    re-enter it across operator incarnations without resetting per-node
+    fault clocks. ``injected`` counts what actually fired, keyed
+    ``kind:node``, for soak assertions ("the profile injected nothing" is a
+    test bug, not a pass).
+    """
+
+    def __init__(self, seed: int = 0, faults: Optional[list[NodeFault]] = None,
+                 tick: float = 0.05, heartbeat: bool = True):
+        self.seed = seed
+        self.faults = list(faults or [])
+        self.tick = tick
+        self.heartbeat = heartbeat
+        self.client = None
+        self.injected: dict[str, int] = defaultdict(int)
+        # node name -> monotonic time first observed (the per-node fault clock)
+        self._first_seen: dict[str, float] = {}
+        # (fault idx, node) -> last state applied, for edge-triggered writes
+        self._applied: dict[tuple[int, str], object] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------- seeding
+    def _draw(self, *key) -> float:
+        h = hashlib.sha256(repr((self.seed,) + key).encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2 ** 64
+
+    def _victim(self, fault: NodeFault, i: int, name: str) -> bool:
+        if not fnmatch(name, fault.match):
+            return False
+        return fault.rate >= 1.0 or self._draw(fault.kind, i, name) < fault.rate
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, client) -> None:
+        self.client = client
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._run(),
+                                             name="node-fault-injector")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the world keeps turning
+                log.warning("node-fault tick failed: %s", e)
+            await asyncio.sleep(self.tick)
+
+    # ------------------------------------------------------------- the tick
+    async def step(self) -> None:
+        """One injection pass over the managed fleet (public so tests can
+        drive injection synchronously without the background task)."""
+        nodes = await self.client.list(
+            Node, labels={wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME})
+        mono = asyncio.get_event_loop().time()
+        for node in nodes:
+            name = node.metadata.name
+            first = self._first_seen.setdefault(name, mono)
+            elapsed = mono - first
+            changed = False
+            silent = False
+            for i, fault in enumerate(self.faults):
+                if not self._victim(fault, i, name):
+                    continue
+                active = fault.start <= elapsed < fault.start + fault.duration
+                changed |= self._apply(fault, i, node, active, elapsed)
+                if fault.kind == "silent" and active:
+                    silent = True
+            if self.heartbeat and not silent:
+                changed |= heartbeat_node(node)
+            if changed:
+                try:
+                    await self.client.update_status(node)
+                except Exception:  # noqa: BLE001 — conflict/NotFound: next
+                    pass           # tick re-reads and re-applies
+
+    def _apply(self, fault: NodeFault, i: int, node: Node, active: bool,
+               elapsed: float) -> bool:
+        key = (i, node.metadata.name)
+        if fault.kind == "flap":
+            # half-period square wave while active; heal to Ready outside
+            want_ready = True
+            if active:
+                want_ready = int((elapsed - fault.start) / fault.period) % 2 == 0
+            if self._applied.get(key) == want_ready:
+                return False
+            self._applied[key] = want_ready
+            flipped = set_node_ready(
+                node, want_ready,
+                reason="KubeletReady" if want_ready else "ChaosFlap")
+            if flipped and not want_ready:
+                self.injected[f"flap:{node.metadata.name}"] += 1
+            return flipped
+        if fault.kind == "degrade":
+            if active:
+                if set_node_condition(node, ACCELERATOR_HEALTHY, "False",
+                                      reason="ChaosDegraded"):
+                    self.injected[f"degrade:{node.metadata.name}"] += 1
+                    return True
+                return False
+            # heal only what we broke — a fresh replacement node without the
+            # condition stays untouched
+            cond = next((c for c in node.status.conditions
+                         if c.type == ACCELERATOR_HEALTHY), None)
+            if cond is not None and cond.status == "False":
+                return set_node_condition(node, ACCELERATOR_HEALTHY, "True",
+                                          reason="ChaosHealed")
+            return False
+        if fault.kind == "maintenance":
+            if active:
+                if set_node_condition(node, MAINTENANCE_SCHEDULED, "True",
+                                      reason="ScheduledMaintenance"):
+                    self.injected[f"maintenance:{node.metadata.name}"] += 1
+                    return True
+                return False
+            cond = next((c for c in node.status.conditions
+                         if c.type == MAINTENANCE_SCHEDULED), None)
+            if cond is not None and cond.status == "True":
+                return set_node_condition(node, MAINTENANCE_SCHEDULED, "False",
+                                          reason="MaintenanceDone")
+            return False
+        if fault.kind == "silent":
+            # the whole point is writing NOTHING: the heartbeat skip happens
+            # in step(); count the window entry once for observability
+            if active and self._applied.get(key) is not True:
+                self._applied[key] = True
+                self.injected[f"silent:{node.metadata.name}"] += 1
+            elif not active:
+                self._applied[key] = False
+            return False
+        return False
+
+    def injected_total(self, prefix: str = "") -> int:
+        return sum(v for k, v in self.injected.items() if k.startswith(prefix))
+
+
+# ------------------------------------------------------------------ profiles
+# Named node-fault profiles: the vocabulary tests/test_health.py, `make
+# repair` and docs/FAILURE_MODES.md share (same registry pattern as
+# policy.PROFILES). Defaults are envtest-timescale; keyword overrides pass
+# through to the underlying NodeFault fields.
+
+NODE_FAULT_PROFILES: dict[str, Callable[..., NodeFaultInjector]] = {}
+
+
+def node_fault_profile(name: str, seed: int = 0, **overrides) -> NodeFaultInjector:
+    try:
+        factory = NODE_FAULT_PROFILES[name]
+    except KeyError:
+        raise ValueError(f"unknown node-fault profile {name!r}; "
+                         f"known: {sorted(NODE_FAULT_PROFILES)}")
+    return factory(seed, **overrides)
+
+
+def _register_profile(name: str):
+    def deco(fn):
+        NODE_FAULT_PROFILES[name] = fn
+        return fn
+    return deco
+
+
+def _faults(base: NodeFault, **overrides) -> list[NodeFault]:
+    return [replace(base, **overrides)]
+
+
+@_register_profile("flapping_node")
+def _flapping_node(seed: int, **kw) -> NodeFaultInjector:
+    """Worker 0 of every pool flaps Ready faster than any toleration: each
+    False interval is short, each flip resets lastTransitionTime. Repair
+    must accrue the flaps (hysteresis) instead of restarting its clock."""
+    return NodeFaultInjector(seed, _faults(NodeFault(
+        kind="flap", match="*-w0", start=0.3, period=0.25, duration=2.0), **kw))
+
+
+@_register_profile("degraded_slice")
+def _degraded_slice(seed: int, **kw) -> NodeFaultInjector:
+    """One host's accelerator degrades (AcceleratorHealthy=False) — for a
+    multi-host slice the ICI ring is broken and the whole slice must be
+    replaced, not just the sick host."""
+    return NodeFaultInjector(seed, _faults(NodeFault(
+        kind="degrade", match="*-w0", start=0.2, duration=60.0), **kw))
+
+
+@_register_profile("silent_death")
+def _silent_death(seed: int, **kw) -> NodeFaultInjector:
+    """Worker 0's kubelet dies silently: heartbeats stop, Ready stays a
+    stale True, and no watch event will ever announce it. Repair's
+    stale-heartbeat policy is the only thing that can see this."""
+    return NodeFaultInjector(seed, _faults(NodeFault(
+        kind="silent", match="*-w0", start=0.3, duration=60.0), **kw))
+
+
+@_register_profile("maintenance_wave")
+def _maintenance_wave(seed: int, **kw) -> NodeFaultInjector:
+    """EVERY managed node gets a scheduled-maintenance notice at once — the
+    correlated-wave signature. The fraction breaker must hold repair back
+    (zero force-deletes while tripped) instead of mass-deleting the fleet."""
+    return NodeFaultInjector(seed, _faults(NodeFault(
+        kind="maintenance", match="*", start=0.2, duration=2.5), **kw))

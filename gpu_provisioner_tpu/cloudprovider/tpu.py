@@ -73,6 +73,11 @@ class TPUCloudProvider:
             RepairPolicy("Ready", "Unknown", self.repair_toleration),
             # TPU extension: device-plugin-reported accelerator health.
             RepairPolicy("AcceleratorHealthy", "False", self.repair_toleration),
+            # TPU extension: host scheduled for maintenance — drain-first
+            # repair replaces the slice ahead of the disruption. A
+            # maintenance WAVE (many nodes at once) is held back by the
+            # health controller's unhealthy-fraction breaker + RepairBudget.
+            RepairPolicy("MaintenanceScheduled", "True", self.repair_toleration),
         ]
 
     def get_supported_node_classes(self) -> list[type]:

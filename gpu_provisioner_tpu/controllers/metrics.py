@@ -163,6 +163,34 @@ OPERATION_WAIT = _get_or_create(
     "Tracked operation duration from registration to resolution.", ["kind"],
     buckets=(0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800))
 
+# ------------------------------------------------------------- node repair
+# Sampled-cumulative gauges (the counters live on controllers/health.py's
+# module registry, which never imports prometheus) + a duration histogram
+# drained at scrape like OPERATION_WAIT.
+
+REPAIR_STARTED = _get_or_create(
+    Gauge, "tpu_provisioner_repair_started",
+    "Node repairs committed (cordon + drain begun; sampled).", [])
+
+REPAIR_SUCCEEDED = _get_or_create(
+    Gauge, "tpu_provisioner_repair_succeeded",
+    "Node repairs that force-deleted the owning NodeClaim (sampled).", [])
+
+REPAIR_THROTTLED = _get_or_create(
+    Gauge, "tpu_provisioner_repair_throttled",
+    "Repair attempts held back by the budget (tokens/concurrency/slice-group "
+    "serialization) or the unhealthy-fraction breaker (sampled).", [])
+
+REPAIR_FLAP_DETECTIONS = _get_or_create(
+    Gauge, "tpu_provisioner_repair_flap_detections",
+    "Nodes whose condition-transition history crossed the hysteresis "
+    "threshold (sampled).", [])
+
+REPAIR_DURATION = _get_or_create(
+    Histogram, "tpu_provisioner_repair_duration_seconds",
+    "Repair duration from commit (cordon) to NodeClaim force-delete.", [],
+    buckets=(0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800))
+
 _CACHE_GAUGES = (
     ("hits", INSTANCE_CACHE_HITS),
     ("misses", INSTANCE_CACHE_MISSES),
@@ -203,6 +231,13 @@ def update_runtime_gauges(manager) -> None:
     # never imports prometheus) and drain into the histogram at scrape
     for kind, seconds in ops.drain_operation_waits():
         OPERATION_WAIT.labels(kind).observe(seconds)
+    from . import health as _health
+    REPAIR_STARTED.set(_health.REPAIR_STATS["started"])
+    REPAIR_SUCCEEDED.set(_health.REPAIR_STATS["succeeded"])
+    REPAIR_THROTTLED.set(_health.REPAIR_STATS["throttled"])
+    REPAIR_FLAP_DETECTIONS.set(_health.REPAIR_STATS["flap_detections"])
+    for seconds in _health.drain_repair_durations():
+        REPAIR_DURATION.observe(seconds)
     # Drop series for breakers whose client closed — a stale "open" reading
     # would keep an alert firing for an endpoint nothing gates on anymore.
     for name in _exported_breakers - set(BREAKERS):

@@ -165,8 +165,13 @@ def build_controllers(client: Client, cloudprovider,
             .watches(NodeClaim, map_fn=group_requests),
         ]
     # Node health only with repair policies + gate (controllers.go:110-113).
+    # Repair drains through the SAME eviction queue the termination
+    # controller owns (drain-first escalation), and carries the mid_repair
+    # crash cut line.
     if node_repair and cloudprovider.repair_policies():
-        health = NodeHealthController(client, cloudprovider, recorder, health_options)
+        health = NodeHealthController(client, cloudprovider, recorder,
+                                      health_options, eviction=eviction,
+                                      crashes=crashes)
         controllers.append(
             Controller(health.NAME, health, max_concurrent=8, **hardening)
             .watches(Node, map_fn=node_map))

@@ -76,26 +76,39 @@ class SliceGroupController:
         from ..providers.instance import instance_name
 
         desired = {wk.TPU_NUM_SLICES_LABEL: str(num_slices)}
+        drop: list[str] = []
         owner0 = next((p for p, i in pool_index.items() if i == 0), None)
         if owner0 is not None:
             # worker 0 of the slice-0 pool, via the one naming-convention seam
             desired[wk.TPU_COORDINATOR_LABEL] = instance_name(
                 self.cluster, owner0, 0)
+        else:
+            # Slice 0 is gone (deleted, or mid-repair): a stale coordinator
+            # label would point workloads at a dead host — strip it until a
+            # replacement pool takes index 0 and gets re-stamped.
+            drop.append(wk.TPU_COORDINATOR_LABEL)
 
         for n in nodes:
-            if all(n.metadata.labels.get(k) == v for k, v in desired.items()):
+            if (all(n.metadata.labels.get(k) == v for k, v in desired.items())
+                    and not any(k in n.metadata.labels for k in drop)):
                 continue
 
-            def mutate(obj, _desired=desired):
-                if all(obj.metadata.labels.get(k) == v
-                       for k, v in _desired.items()):
-                    return False
-                obj.metadata.labels.update(_desired)
-                return True
+            def mutate(obj, _desired=desired, _drop=drop):
+                changed = False
+                for k, v in _desired.items():
+                    if obj.metadata.labels.get(k) != v:
+                        obj.metadata.labels[k] = v
+                        changed = True
+                for k in _drop:
+                    if k in obj.metadata.labels:
+                        del obj.metadata.labels[k]
+                        changed = True
+                return True if changed else False
 
             await patch_retry(self.client, Node, n.metadata.name, mutate)
             log.info("slice-group %s: synced identity labels onto node %s "
-                     "(%s)", group, n.metadata.name, desired)
+                     "(%s%s)", group, n.metadata.name, desired,
+                     f", dropped {drop}" if drop else "")
 
         # periodic resync guards against missed watch events (group members
         # appear via pool joins the Node watch does see, but cheap insurance)

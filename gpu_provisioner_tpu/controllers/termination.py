@@ -146,6 +146,37 @@ class EvictionQueue:
             await asyncio.sleep(self.interval)
 
 
+async def taint_disrupted(client: Client, node: Node) -> None:
+    """Cordon-taint a node ``karpenter.sh/disrupted:NoSchedule``
+    (controller.go:135-141). Shared by node termination and node repair —
+    repair's drain-first escalation cordons through the same seam so the
+    scheduler sees one disruption vocabulary."""
+    def mutate(n: Node):
+        if any(t.key == wk.DISRUPTED_TAINT for t in n.spec.taints):
+            return False
+        n.spec.taints.append(Taint(key=wk.DISRUPTED_TAINT, effect="NoSchedule"))
+    await patch_retry(client, Node, node.metadata.name, mutate)
+
+
+async def drain_node(client: Client, queue: EvictionQueue, node: Node) -> bool:
+    """Evict all drainable pods on ``node``; True when none remain
+    (terminator.go:96-117). Daemonset pods and terminal pods are skipped;
+    higher-priority pods are evicted only after lower-priority ones are gone
+    (the reference drains in priority waves). One home for the drain pass:
+    the termination controller's finalizer flow and the health controller's
+    drain-first repair escalation both route evictions through here."""
+    pods = [p for p in await client.list(Pod)
+            if p.spec.node_name == node.metadata.name
+            and not p.is_owned_by_daemonset() and not p.is_terminal()]
+    if not pods:
+        return True
+    min_priority = min(p.spec.priority for p in pods)
+    for p in pods:
+        if p.spec.priority == min_priority:
+            queue.enqueue(p)
+    return False
+
+
 @dataclass
 class TerminationOptions:
     requeue: float = 1.0
@@ -228,11 +259,7 @@ class NodeTerminationController:
         return Result()
 
     async def _taint_disrupted(self, node: Node) -> None:
-        def mutate(n: Node):
-            if any(t.key == wk.DISRUPTED_TAINT for t in n.spec.taints):
-                return False
-            n.spec.taints.append(Taint(key=wk.DISRUPTED_TAINT, effect="NoSchedule"))
-        await patch_retry(self.client, Node, node.metadata.name, mutate)
+        await taint_disrupted(self.client, node)
 
     async def _instance_gone(self, node: Node) -> bool:
         if not node.spec.provider_id:
@@ -257,20 +284,7 @@ class NodeTerminationController:
             return False
 
     async def _drain(self, node: Node) -> bool:
-        """Evict all drainable pods; True when none remain
-        (terminator.go:96-117). Daemonset pods and terminal pods are skipped;
-        higher-priority pods are evicted only after lower-priority ones are
-        gone (the reference drains in priority waves)."""
-        pods = [p for p in await self.client.list(Pod)
-                if p.spec.node_name == node.metadata.name
-                and not p.is_owned_by_daemonset() and not p.is_terminal()]
-        if not pods:
-            return True
-        min_priority = min(p.spec.priority for p in pods)
-        for p in pods:
-            if p.spec.priority == min_priority:
-                self.queue.enqueue(p)
-        return False
+        return await drain_node(self.client, self.queue, node)
 
     async def _volumes_detached(self, node: Node) -> bool:
         attachments = [va for va in await self.client.list(VolumeAttachment)

@@ -66,7 +66,34 @@ class EnvtestOptions:
     # simulated node-ready lag under load or repair reaps claims mid-launch;
     # repair tests shrink it explicitly.
     repair_toleration: float = 30.0
-    repair_max_unhealthy_fraction: float = 0.0
+    # Unhealthy-fraction breaker: now DEFAULT ON (0.5), guarded by the
+    # minimum-unhealthy count so small fleets (most tests) still repair —
+    # the breaker exists for correlated waves, not independent faults.
+    repair_max_unhealthy_fraction: float = 0.5
+    repair_breaker_min_unhealthy: int = 3
+    # Hysteresis window, envtest timescale (production: 5 flips / 10 min).
+    repair_flap_threshold: int = 4
+    repair_flap_window: float = 10.0
+    # Stale-heartbeat repair bound; 0 (off) unless a scenario runs the
+    # node-fault injector (the injector is envtest's only heartbeat source,
+    # so enabling this without it would brand every node dead).
+    repair_heartbeat_bound: float = 0.0
+    # Drain-first escalation + budget, envtest timescale.
+    repair_drain_deadline: float = 1.0
+    repair_drain_requeue: float = 0.05
+    repair_throttle_requeue: float = 0.1
+    repair_rate: float = 0.0
+    repair_rate_interval: float = 60.0
+    repair_burst: int = 0
+    repair_max_concurrent: int = 0
+    repair_breaker_ttl: float = 0.05
+    # Node-fault injection (chaos.NodeFaultInjector or a profile built by
+    # chaos.node_fault_profile(name, seed)): started against the RAW client
+    # with the Env (faults are the world's doing — kube chaos must not gate
+    # them) and stopped at teardown. start() is idempotent, so a
+    # RestartableEnv's incarnations share one injector and its per-node
+    # fault clocks.
+    node_faults: object = None
     max_concurrent_reconciles: int = 64
     # Claim-shard partitioning (controllers/registry.py): an Env built with
     # shards>1 runs ONE shard's controller set — partition tests assert a
@@ -180,7 +207,19 @@ class Env:
             gc_options=GCOptions(interval=self.opts.gc_interval,
                                  leak_grace=self.opts.leak_grace),
             health_options=HealthOptions(
-                max_unhealthy_fraction=self.opts.repair_max_unhealthy_fraction),
+                max_unhealthy_fraction=self.opts.repair_max_unhealthy_fraction,
+                breaker_min_unhealthy=self.opts.repair_breaker_min_unhealthy,
+                breaker_ttl=self.opts.repair_breaker_ttl,
+                flap_threshold=self.opts.repair_flap_threshold,
+                flap_window=self.opts.repair_flap_window,
+                heartbeat_bound=self.opts.repair_heartbeat_bound,
+                drain_deadline=self.opts.repair_drain_deadline,
+                drain_requeue=self.opts.repair_drain_requeue,
+                throttle_requeue=self.opts.repair_throttle_requeue,
+                repair_rate=self.opts.repair_rate,
+                repair_interval=self.opts.repair_rate_interval,
+                repair_burst=self.opts.repair_burst,
+                max_concurrent_repairs=self.opts.repair_max_concurrent),
             max_concurrent_reconciles=self.opts.max_concurrent_reconciles,
             shards=self.opts.shards, shard_index=self.opts.shard_index,
             reconcile_timeout=self.opts.reconcile_timeout,
@@ -197,6 +236,10 @@ class Env:
             await self.informers.start()   # sync before the first reconcile
         if self.tracker is not None:
             self.tracker.start()
+        if self.opts.node_faults is not None:
+            # raw client: the injector is the world (kubelets/hardware), not
+            # part of the operator — kube chaos must not gate its writes
+            self.opts.node_faults.start(self.client)
         self.eviction.start()
         await self.manager.start()
         return self
@@ -204,6 +247,8 @@ class Env:
     async def __aexit__(self, *exc) -> None:
         await self.manager.stop()
         await self.eviction.stop()
+        if self.opts.node_faults is not None:
+            await self.opts.node_faults.stop()
         if self.tracker is not None:
             await self.tracker.stop()
         if self.informers is not None:

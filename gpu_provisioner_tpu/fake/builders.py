@@ -52,3 +52,49 @@ def make_node(name: str, provider_id: str = "", pool: str = "",
         reason="KubeletReady" if ready else "KubeletNotReady",
         last_transition_time=now()))
     return n
+
+
+# ------------------------------------------------ node condition helpers
+# The node-fault injector (chaos/nodefaults.py) and health tests drive Node
+# state through these so every fault writes conditions the way a kubelet
+# would: lastTransitionTime bumps ONLY when the status value flips, and the
+# heartbeat refreshes independently of the status.
+
+def set_node_condition(node: Node, ctype: str, status: str,
+                       reason: str = "", message: str = "") -> bool:
+    """Set (or create) a Node status condition; returns True when the status
+    value actually flipped (and stamps a fresh lastTransitionTime)."""
+    cond = next((c for c in node.status.conditions if c.type == ctype), None)
+    if cond is None:
+        cond = Condition(type=ctype)
+        node.status.conditions.append(cond)
+        changed = True
+    else:
+        changed = cond.status != status
+    if changed:
+        cond.last_transition_time = now()
+    cond.status = status
+    cond.reason = reason or ctype
+    cond.message = message
+    return changed
+
+
+def set_node_ready(node: Node, ready: bool, reason: str = "") -> bool:
+    """Flip the kubelet Ready condition; transition time bumps on change."""
+    return set_node_condition(
+        node, "Ready", "True" if ready else "False",
+        reason or ("KubeletReady" if ready else "KubeletNotReady"))
+
+
+def heartbeat_node(node: Node, at=None) -> bool:
+    """Refresh the Ready condition's lastHeartbeatTime — what a live kubelet
+    does every status-report interval regardless of the status value.
+    ``at=None`` stamps a FULL-resolution timestamp (not the second-truncated
+    ``now()``): envtest compresses heartbeat intervals below a second, where
+    truncation would alias consecutive beats."""
+    cond = node.ready_condition()
+    if cond is None:
+        return False
+    from datetime import datetime, timezone
+    cond.last_heartbeat_time = at or datetime.now(timezone.utc)
+    return True

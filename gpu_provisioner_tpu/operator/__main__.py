@@ -33,6 +33,16 @@ async def run_simulate(opts) -> int:
         gc_interval=opts.gc_interval_seconds,
         leak_grace=opts.gc_leak_grace_seconds,
         repair_toleration=opts.repair_toleration_seconds)
+    env_opts.repair_max_unhealthy_fraction = opts.repair_max_unhealthy_fraction
+    env_opts.repair_breaker_min_unhealthy = opts.repair_breaker_min_unhealthy
+    env_opts.repair_flap_threshold = opts.repair_flap_threshold
+    env_opts.repair_flap_window = opts.repair_flap_window_seconds
+    env_opts.repair_heartbeat_bound = opts.repair_heartbeat_bound_seconds
+    env_opts.repair_drain_deadline = opts.repair_drain_deadline_seconds
+    env_opts.repair_rate = opts.repair_rate
+    env_opts.repair_rate_interval = opts.repair_rate_interval_seconds
+    env_opts.repair_burst = opts.repair_burst
+    env_opts.repair_max_concurrent = opts.repair_max_concurrent
     env_opts.lifecycle.liveness_enabled = opts.liveness_enabled
     env_opts.lifecycle.launch_timeout = opts.launch_timeout_seconds
     env_opts.lifecycle.registration_timeout = opts.registration_timeout_seconds
@@ -186,7 +196,16 @@ async def run_real(opts) -> int:
         gc_options=GCOptions(interval=opts.gc_interval_seconds,
                              leak_grace=opts.gc_leak_grace_seconds),
         health_options=HealthOptions(
-            max_unhealthy_fraction=opts.repair_max_unhealthy_fraction),
+            max_unhealthy_fraction=opts.repair_max_unhealthy_fraction,
+            breaker_min_unhealthy=opts.repair_breaker_min_unhealthy,
+            flap_threshold=opts.repair_flap_threshold,
+            flap_window=opts.repair_flap_window_seconds,
+            heartbeat_bound=opts.repair_heartbeat_bound_seconds,
+            drain_deadline=opts.repair_drain_deadline_seconds,
+            repair_rate=opts.repair_rate,
+            repair_interval=opts.repair_rate_interval_seconds,
+            repair_burst=opts.repair_burst,
+            max_concurrent_repairs=opts.repair_max_concurrent),
         max_concurrent_reconciles=opts.max_concurrent_reconciles,
         node_repair=opts.feature_gates.node_repair,
         cluster=cfg.cluster_name,

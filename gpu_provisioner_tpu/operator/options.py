@@ -36,12 +36,32 @@ class Options:
     instance_requeue_seconds: float = 5.0      # node termination await-instance
     repair_toleration_seconds: float = 600.0   # cloudprovider.go:103-116
     # Cluster repair circuit breaker: skip auto-repair when more than this
-    # fraction of managed nodes is unhealthy (0 = off, the reference's
-    # active behavior — its breaker is commented out at
-    # health/controller.go:130-151). Worth enabling for TPU fleets: one
-    # bad rollout marking many slices unhealthy must not trigger a mass
-    # delete of expensive capacity.
-    repair_max_unhealthy_fraction: float = 0.0
+    # fraction of managed nodes is unhealthy AND at least
+    # repair_breaker_min_unhealthy nodes are unhealthy (so a one-slice
+    # fleet can still be repaired). DEFAULT ON (the reference's breaker is
+    # commented out at health/controller.go:130-151): one bad rollout or
+    # maintenance wave marking many slices unhealthy must not trigger a
+    # mass delete of expensive capacity. 0 = off.
+    repair_max_unhealthy_fraction: float = 0.5
+    repair_breaker_min_unhealthy: int = 3
+    # Flap hysteresis: N observed condition transitions inside the window ==
+    # unhealthy, even though each individual Ready=False interval is short.
+    repair_flap_threshold: int = 5
+    repair_flap_window_seconds: float = 600.0
+    # Stale-heartbeat repair (lastHeartbeatTime older than bound → kubelet
+    # treated as dead even while Ready reads a stale True). 0 = off, the
+    # safe default where the node-lifecycle-controller marks silent nodes
+    # Unknown; enable on clusters where that signal is missing or slow.
+    repair_heartbeat_bound_seconds: float = 0.0
+    # Drain-first escalation: cordon + evict with this deadline before the
+    # NodeClaim force-delete.
+    repair_drain_deadline_seconds: float = 300.0
+    # RepairBudget: token bucket (rate per interval, burst cap) + max
+    # concurrently-active repairs. Slice-group serialization is always on.
+    repair_rate: float = 6.0
+    repair_rate_interval_seconds: float = 3600.0
+    repair_burst: int = 3
+    repair_max_concurrent: int = 2
     max_concurrent_reconciles: int = 64
     # Claim-shard horizontal scaling (controllers/registry.py): run N
     # replicas, each with a distinct SHARD_INDEX; per-claim work partitions
@@ -109,7 +129,21 @@ def parse_options(argv=None, env=None) -> Options:
         repair_toleration_seconds=float(
             e.get("REPAIR_TOLERATION_SECONDS", "600")),
         repair_max_unhealthy_fraction=float(
-            e.get("REPAIR_MAX_UNHEALTHY_FRACTION", "0")),
+            e.get("REPAIR_MAX_UNHEALTHY_FRACTION", "0.5")),
+        repair_breaker_min_unhealthy=int(
+            e.get("REPAIR_BREAKER_MIN_UNHEALTHY", "3")),
+        repair_flap_threshold=int(e.get("REPAIR_FLAP_THRESHOLD", "5")),
+        repair_flap_window_seconds=float(
+            e.get("REPAIR_FLAP_WINDOW_SECONDS", "600")),
+        repair_heartbeat_bound_seconds=float(
+            e.get("REPAIR_HEARTBEAT_BOUND_SECONDS", "0")),
+        repair_drain_deadline_seconds=float(
+            e.get("REPAIR_DRAIN_DEADLINE_SECONDS", "300")),
+        repair_rate=float(e.get("REPAIR_RATE", "6")),
+        repair_rate_interval_seconds=float(
+            e.get("REPAIR_RATE_INTERVAL_SECONDS", "3600")),
+        repair_burst=int(e.get("REPAIR_BURST", "3")),
+        repair_max_concurrent=int(e.get("REPAIR_MAX_CONCURRENT", "2")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
         shards=int(e.get("SHARDS", "1")),
         shard_index=_shard_index_env(e),

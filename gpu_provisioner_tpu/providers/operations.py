@@ -219,6 +219,7 @@ class OperationTracker:
         self._subs: list[Callable[[TrackedOperation], Awaitable[None]]] = []
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        self._stopping = False
         # observability (tests, /metrics sampling)
         self.poll_batches = 0
         self.poll_errors = 0
@@ -229,12 +230,20 @@ class OperationTracker:
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:
         if self._task is None or self._task.done():
+            self._stopping = False
             self._task = asyncio.create_task(
                 self._run(), name=f"operation-tracker/{id(self):x}")
 
     async def stop(self) -> None:
         task, self._task = self._task, None
         if task is not None:
+            # belt AND braces: py3.10's wait_for swallows a cancellation
+            # that races a completed inner future (bpo-42130), so cancel
+            # alone can leave the poller alive and parked on _wake forever
+            # while we await it — the flag + wake makes the loop exit on
+            # its own at the next resume even when the cancel is eaten
+            self._stopping = True
+            self._wake.set()
             task.cancel()
             try:
                 await task
@@ -334,12 +343,16 @@ class OperationTracker:
     async def _run(self) -> None:
         ladder = BackoffLadder(float("inf"), self.interval,
                                jitter=self.jitter, cap=self.max_interval)
-        while True:
+        while not self._stopping:
             if not any(op.in_progress for op in self._ops.values()):
                 self._wake.clear()
+                if self._stopping:
+                    return
                 # idle: zero cloud calls until the next registration
                 await self._wake.wait()
                 ladder.reset()
+            if self._stopping:
+                return
             # pace the next batched poll; a registration landing mid-sleep
             # interrupts it and resets the cadence — new work must not wait
             # out a backed-off interval for its first observation
@@ -350,6 +363,8 @@ class OperationTracker:
                 ladder.reset()
             except asyncio.TimeoutError:
                 pass
+            if self._stopping:
+                return
             if await self._tick():
                 ladder.reset()
 

@@ -1,0 +1,640 @@
+"""Node-fault soak matrix + repair regression suite (controllers/health.py).
+
+Every soak runs the WHOLE provisioner (envtest) with a seeded
+``chaos.NodeFaultInjector`` playing the kubelet fleet, a KAITO-simulating
+replacer recreating claims the repair loop deletes, and asserts the repair
+invariants:
+
+1. every workload converges back to Ready once the fault window closes;
+2. zero orphaned pools / queued resources — the fake cloud exactly matches
+   the surviving claims;
+3. total repairs never exceed the configured RepairBudget;
+4. the ``maintenance_wave`` + fraction-breaker case performs ZERO
+   force-deletes while the breaker is tripped.
+
+The full profile × workload matrix is marked ``slow`` (run via
+``make repair``); the regression tests (flap bug pin, observed-staleness
+anchoring, truncation robustness, budget/breaker units, mid-repair crash ×
+recovery) stay in tier-1.
+"""
+
+import asyncio
+import os
+from collections import defaultdict
+
+import pytest
+
+from gpu_provisioner_tpu import chaos
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Node, Pod, PodSpec
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY, ObjectMeta
+from gpu_provisioner_tpu.controllers.health import (
+    REPAIR_STATS, HealthOptions, NodeHealthController, RepairBudget,
+)
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions, RestartableEnv
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.fake.builders import set_node_condition, set_node_ready
+from gpu_provisioner_tpu.runtime import NotFoundError
+
+from .conftest import async_test
+
+pytestmark = [pytest.mark.chaos, pytest.mark.repair]
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+def repair_env(injector=None, **kw) -> Env:
+    """Envtest tuned for repair soaks: fast GC, short toleration, hysteresis
+    at test timescale, breaker off unless the scenario turns it on."""
+    kw.setdefault("gc_interval", 0.1)
+    kw.setdefault("leak_grace", 0.1)
+    kw.setdefault("repair_toleration", 0.4)
+    kw.setdefault("repair_flap_threshold", 3)
+    kw.setdefault("repair_flap_window", 6.0)
+    kw.setdefault("repair_drain_deadline", 0.6)
+    kw.setdefault("repair_drain_requeue", 0.05)
+    kw.setdefault("repair_throttle_requeue", 0.1)
+    kw.setdefault("repair_max_unhealthy_fraction", 0.0)
+    opts = EnvtestOptions(node_faults=injector, **kw)
+    opts.lifecycle.launch_timeout = 20.0
+    opts.lifecycle.registration_timeout = 20.0
+    return Env(opts)
+
+
+# (claim name, shape, slice-group) per workload case of the matrix.
+SHAPES = {
+    "single-host": [("h0", "tpu-v5e-8", None)],
+    "multi-host": [("mh0", "tpu-v5p-32", None)],
+    "multi-slice-group": [("g0", "tpu-v5e-16", "g"),
+                          ("g1", "tpu-v5e-16", "g")],
+}
+
+
+def _claim(name, shape, group):
+    labels = {wk.TPU_SLICE_GROUP_LABEL: group} if group else None
+    return make_nodeclaim(name, shape, labels=labels)
+
+
+def start_replacer(env: Env, specs):
+    """KAITO simulation: repair deletes a NodeClaim; the workspace
+    controller would recreate it. Returns (task, per-claim recreate counts)."""
+    counts = defaultdict(int)
+
+    async def run():
+        while True:
+            for name, shape, group in specs:
+                try:
+                    await env.client.get(NodeClaim, name)
+                except NotFoundError:
+                    try:
+                        await env.client.create(_claim(name, shape, group))
+                        counts[name] += 1
+                    except Exception:  # noqa: BLE001 — create race; next lap
+                        pass
+                except Exception:  # noqa: BLE001 — transient read error
+                    pass
+            await asyncio.sleep(0.05)
+
+    return asyncio.create_task(run()), counts
+
+
+async def wait_repaired_and_converged(env: Env, names, timeout=20.0):
+    """All claims Ready AND no managed node matches any repair policy."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        ok = True
+        for name in names:
+            try:
+                nc = await env.client.get(NodeClaim, name)
+            except NotFoundError:
+                ok = False
+                break
+            if not nc.status_conditions.is_true(CONDITION_READY):
+                ok = False
+                break
+        if ok:
+            nodes = await env.client.list(
+                Node, labels={wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME})
+            hc = _health_controller(env)
+            if any(hc._match_policy(n) is not None for n in nodes):
+                ok = False
+        if ok:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"repair never converged {sorted(names)}")
+        await asyncio.sleep(0.05)
+
+
+def _health_controller(env: Env) -> NodeHealthController:
+    c = next(c for c in env.manager.controllers if c.name == "node.health")
+    return c.reconciler
+
+
+async def assert_no_leaks(env: Env, names: set, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        pools = set(env.cloud.nodepools.pools)
+        qrs = set(env.cloud.queuedresources.resources)
+        nodes = await env.client.list(Node)
+        node_pools = {n.metadata.labels.get(wk.GKE_NODEPOOL_LABEL)
+                      for n in nodes}
+        if pools == names and not qrs and node_pools <= names | {None}:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"leak invariant violated: pools={sorted(pools)} (want "
+                f"{sorted(names)}), qrs={sorted(qrs)}, orphan-node-pools="
+                f"{sorted((node_pools - names) - {None}, key=str)}")
+        await asyncio.sleep(0.05)
+
+
+def _stats():
+    return {k: REPAIR_STATS[k] for k in
+            ("started", "succeeded", "throttled", "flap_detections")}
+
+
+# ------------------------------------------------------- the soak matrix
+
+MATRIX = [(p, s) for p in ("flapping_node", "degraded_slice", "silent_death",
+                           "maintenance_wave")
+          for s in ("single-host", "multi-host", "multi-slice-group")]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("profile,shape", MATRIX)
+@async_test
+async def test_repair_soak_matrix(profile, shape):
+    specs = SHAPES[shape]
+    names = {n for n, _, _ in specs}
+    # windows sized so the repair reliably lands INSIDE the fault (stale-
+    # heartbeat detection alone costs bound + truncation slack), while a
+    # replacement node re-entering the window still converges once it closes
+    overrides = {"degraded_slice": dict(duration=1.5),
+                 "flapping_node": dict(duration=4.0),
+                 "silent_death": dict(duration=6.0)}.get(profile, {})
+    inj = chaos.node_fault_profile(profile, seed=SEED, **overrides)
+    env_kw = dict(repair_rate=6.0, repair_rate_interval=60.0, repair_burst=6,
+                  repair_max_concurrent=4)
+    wave = profile == "maintenance_wave"
+    if wave:
+        # the correlated-wave case: breaker ON, trippable at any fleet size
+        env_kw.update(repair_max_unhealthy_fraction=0.5,
+                      repair_breaker_min_unhealthy=1,
+                      repair_breaker_ttl=0.2)
+    if profile == "silent_death":
+        env_kw.update(repair_heartbeat_bound=0.5)
+    else:
+        inj.heartbeat = False  # cut heartbeat write churn where irrelevant
+
+    before = _stats()
+    deletes0 = None
+    async with repair_env(inj, **env_kw) as env:
+        deletes0 = env.cloud.nodepools.calls["begin_delete"]
+        for name, shp, group in specs:
+            await env.client.create(_claim(name, shp, group))
+        for name, _, _ in specs:
+            await env.wait_ready(name, timeout=15)
+        replacer, counts = start_replacer(env, specs)
+        t0 = asyncio.get_event_loop().time()
+        try:
+            if wave:
+                # breaker holds everything back: just outlive the wave
+                await asyncio.sleep(1.0)
+            else:
+                # flap and silent-death are invisible to a point-in-time
+                # _match_policy scan (Ready reads True) — convergence alone
+                # can't prove the fault bit. Wait for a completed repair
+                # first, then for convergence.
+                deadline = asyncio.get_event_loop().time() + 20.0
+                while _stats()["succeeded"] <= before["succeeded"]:
+                    assert asyncio.get_event_loop().time() < deadline, \
+                        "no repair ever completed under the fault"
+                    await asyncio.sleep(0.05)
+            await wait_repaired_and_converged(env, names, timeout=30.0)
+            await assert_no_leaks(env, names)
+        finally:
+            replacer.cancel()
+        elapsed = asyncio.get_event_loop().time() - t0
+        after = _stats()
+
+        if wave:
+            # breaker tripped for the whole wave: ZERO force-deletes, and the
+            # trip was actually exercised
+            assert after["succeeded"] == before["succeeded"], \
+                "maintenance wave force-deleted a slice through the breaker"
+            assert env.cloud.nodepools.calls["begin_delete"] == deletes0
+            assert after["throttled"] > before["throttled"], \
+                "breaker never held a repair back"
+            assert sum(counts.values()) == 0, counts
+            assert inj.injected_total("maintenance:") > 0
+        else:
+            assert inj.injected_total() > 0, "profile injected nothing"
+            assert after["succeeded"] > before["succeeded"], \
+                "no repair ever completed under the fault"
+            # the budget ceiling: burst + rate·elapsed/interval (+1 slack for
+            # the window boundary)
+            allowed = 6 + 6.0 * elapsed / 60.0 + 1
+            assert after["succeeded"] - before["succeeded"] <= allowed
+
+        if shape == "multi-slice-group" and not wave:
+            # slice-group identity re-converged: every group node re-stamped
+            # with a coordinator that is a live index-0 worker (stamping
+            # rides node watch events; poll a short settle window)
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while True:
+                nodes = await env.client.list(
+                    Node, labels={wk.TPU_SLICE_GROUP_LABEL: "g"})
+                coords = {n.metadata.labels.get(wk.TPU_COORDINATOR_LABEL)
+                          for n in nodes}
+                owner = next((n for n in nodes
+                              if n.metadata.name in coords), None)
+                if (nodes and len(coords) == 1 and owner is not None
+                        and owner.metadata.labels.get(
+                            wk.TPU_SLICE_INDEX_LABEL) == "0"):
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"coordinator never re-converged: {coords}"
+                await asyncio.sleep(0.05)
+
+
+# ------------------------------------------------ flap bug pin + hysteresis
+
+@async_test
+async def test_prepr_flap_bug_pinned_without_hysteresis():
+    """Regression pin of today's bug: a node whose Ready oscillates faster
+    than the toleration resets the toleration clock on every flip and is
+    NEVER repaired by the pre-hysteresis controller (flap_threshold=0)."""
+    inj = chaos.node_fault_profile("flapping_node", seed=SEED, duration=30.0)
+    inj.heartbeat = False
+    async with repair_env(inj, repair_flap_threshold=0,
+                          repair_toleration=0.5) as env:
+        await env.client.create(make_nodeclaim("fb0"))
+        await env.wait_ready("fb0", timeout=15)
+        await asyncio.sleep(2.5)  # many flap periods, several tolerations
+        assert inj.injected_total("flap:") >= 2, "fault never bit"
+        # the claim survived every flip: each Ready=False interval is shorter
+        # than the toleration and the transition resets the clock
+        nc = await env.client.get(NodeClaim, "fb0")
+        assert nc.metadata.name == "fb0"
+        assert "fb0" in env.cloud.nodepools.pools
+
+
+@async_test
+async def test_flap_hysteresis_repairs_flapping_node():
+    """The same flapping node IS repaired once the condition-history window
+    accrues the flips (N transitions inside W == unhealthy), and the repair
+    surface is visible on /metrics."""
+    from gpu_provisioner_tpu.controllers.metrics import (
+        REPAIR_FLAP_DETECTIONS, REPAIR_SUCCEEDED, update_runtime_gauges,
+    )
+
+    before = _stats()
+    inj = chaos.node_fault_profile("flapping_node", seed=SEED, duration=30.0)
+    inj.heartbeat = False
+    async with repair_env(inj, repair_flap_threshold=3,
+                          repair_toleration=0.5) as env:
+        await env.client.create(make_nodeclaim("fh0"))
+        await env.wait_ready("fh0", timeout=15)
+        await env.wait_gone("fh0", timeout=15)  # hysteresis kills the flapper
+        after = _stats()
+        assert after["flap_detections"] > before["flap_detections"]
+        assert after["succeeded"] > before["succeeded"]
+        update_runtime_gauges(env.manager)
+        assert REPAIR_FLAP_DETECTIONS._value.get() >= after["flap_detections"]
+        assert REPAIR_SUCCEEDED._value.get() >= after["succeeded"]
+
+
+# ------------------------------- observed-staleness + truncation robustness
+
+@async_test
+async def test_none_transition_time_is_anchored_not_ignored():
+    """Satellite bugfix: a matching condition with last_transition_time=None
+    used to compute elapsed=0.0 forever (requeue on the full toleration,
+    never repaired). It is now anchored at first observation and repaired
+    once the toleration of OBSERVED unhealthiness elapses."""
+    async with repair_env(repair_toleration=0.4) as env:
+        await env.client.create(make_nodeclaim("nt0"))
+        await env.wait_ready("nt0", timeout=15)
+        node = await env.client.get(Node, "gke-kaito-nt0-w0")
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                c.status = "False"
+                c.reason = "KubeletDead"
+                c.last_transition_time = None
+        await env.client.update_status(node)
+        await env.wait_gone("nt0", timeout=10)
+
+
+@async_test
+async def test_truncated_transition_time_never_fires_early():
+    """Satellite bugfix: metav1.Time is second-resolution, so a freshly
+    flipped condition can read up to 1s old — the toleration check must not
+    treat that truncation error as elapsed unhealthy time (the same bug
+    PR 3 fixed in the GC leak grace)."""
+    async with repair_env(repair_toleration=0.8) as env:
+        await env.client.create(make_nodeclaim("tt0"))
+        await env.wait_ready("tt0", timeout=15)
+        node = await env.client.get(Node, "gke-kaito-tt0-w0")
+        set_node_ready(node, False, reason="JustFlipped")  # truncated stamp
+        await env.client.update_status(node)
+        # pre-PR: (now - truncated ltt) could read ~1s > 0.8 immediately →
+        # premature repair. Now: label age is slack-adjusted and the
+        # observed-for anchor has only just started.
+        await asyncio.sleep(0.4)
+        nc = await env.client.get(NodeClaim, "tt0")
+        assert nc.metadata.name == "tt0", "repair fired inside the toleration"
+        # ...but the genuinely-unhealthy node IS repaired once observed long
+        # enough
+        await env.wait_gone("tt0", timeout=10)
+
+
+# ----------------------------------------------------- breaker + budget
+
+def test_repair_budget_tokens_concurrency_and_group_serialization():
+    b = RepairBudget(rate=2.0, interval=10.0, burst=2, max_concurrent=2)
+    assert b.try_start("n1", "g1", 0.0) is None
+    # same slice group: serialized no matter the budget
+    why = b.try_start("n2", "g1", 0.0)
+    assert why and "slice group" in why
+    assert b.try_start("n2", "g2", 0.0) is None
+    # concurrency cap
+    why = b.try_start("n3", "g3", 0.0)
+    assert why and "in flight" in why
+    # release frees the group and the slot, but tokens are spent
+    b.release("n1")
+    b.release("n2")
+    why = b.try_start("n3", "g3", 0.0)
+    assert why and "rate budget" in why
+    # tokens refill over time
+    assert b.try_start("n3", "g3", 6.0) is None
+    # re-entry of an active repair consumes nothing
+    assert b.try_start("n3", "g3", 6.0) is None
+    assert b.started_total == 3
+
+
+@async_test
+async def test_circuit_breaker_verdict_memoized_on_labeled_index():
+    """Satellite: the breaker must ride the label inverted index (managed
+    nodes only) and answer a repair WAVE from one memoized list, not one
+    kube list per repair decision."""
+    calls = []
+
+    class CountingClient:
+        async def list(self, cls, labels=None, **kw):
+            calls.append(labels)
+            return []
+
+    class CP:
+        def repair_policies(self):
+            return []
+
+    hc = NodeHealthController(
+        CountingClient(), CP(),
+        options=HealthOptions(max_unhealthy_fraction=0.5, breaker_ttl=10.0))
+    assert not await hc._circuit_broken(0.0)
+    assert not await hc._circuit_broken(1.0)
+    assert not await hc._circuit_broken(9.9)
+    assert len(calls) == 1, "breaker listed once per decision, not per TTL"
+    assert calls[0] == {wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME}
+    assert not await hc._circuit_broken(10.1)
+    assert len(calls) == 2, "memo never expired"
+
+
+@async_test
+async def test_budget_caps_a_correlated_repair_wave():
+    """Three independently-sick slices, budget of ONE repair: exactly one
+    claim is repaired inside the horizon, the rest are throttled (visible on
+    the metric), and nothing leaks."""
+    before = _stats()
+    async with repair_env(repair_toleration=0.2, repair_rate=1.0,
+                          repair_rate_interval=600.0, repair_burst=1,
+                          repair_max_concurrent=1) as env:
+        names = ["bw0", "bw1", "bw2"]
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        for n in names:
+            await env.wait_ready(n, timeout=15)
+        for n in names:
+            node = await env.client.get(Node, f"gke-kaito-{n}-w0")
+            set_node_condition(node, "AcceleratorHealthy", "False",
+                               reason="HardwareFault")
+            await env.client.update_status(node)
+        await asyncio.sleep(2.0)  # several tolerations + throttle requeues
+        survivors = []
+        for n in names:
+            try:
+                await env.client.get(NodeClaim, n)
+                survivors.append(n)
+            except NotFoundError:
+                pass
+        after = _stats()
+        assert len(survivors) == 2, \
+            f"budget of 1 allowed {3 - len(survivors)} repairs"
+        assert after["succeeded"] - before["succeeded"] == 1
+        assert after["throttled"] > before["throttled"]
+
+
+# ------------------------------------------------- repair × crash recovery
+
+@async_test
+async def test_mid_repair_crash_then_restart_converges_without_double_delete():
+    """Satellite: crash the operator at the new mid_repair cut line (node
+    cordoned, budget token consumed in-memory, claim not yet deleted) inside
+    a multi-slice group. The restarted incarnation — fresh budget state plus
+    the PR 3 startup resync — must finish the repair exactly once, never
+    touch the healthy member, and re-stamp the group coordinator on the
+    replacement."""
+    # a big budget: EVERY repair attempt of the doomed incarnation crashes
+    # before its force-delete — otherwise a sibling health worker could
+    # finish the repair between the first crash and the restart
+    crashes = chaos.CrashPoints(at={"mid_repair": 1000}, seed=SEED)
+    opts = EnvtestOptions(gc_interval=0.1, leak_grace=0.1, crashes=crashes,
+                          repair_toleration=0.3,
+                          repair_max_unhealthy_fraction=0.0,
+                          repair_drain_deadline=0.6,
+                          repair_drain_requeue=0.05)
+    opts.lifecycle.launch_timeout = 20.0
+    renv = RestartableEnv(opts)
+    await renv.start()
+    try:
+        for name in ("g0", "g1"):
+            await renv.client.create(_claim(name, "tpu-v5e-16", "g"))
+        for name in ("g0", "g1"):
+            await renv.wait_ready(name, timeout=20)
+        g1_uid = (await renv.client.get(NodeClaim, "g1")).metadata.uid
+        # a pod makes the drain-first path non-trivial across the crash
+        await renv.client.create(Pod(
+            metadata=ObjectMeta(name="payload", namespace="default"),
+            spec=PodSpec(node_name="gke-kaito-g0-w0")))
+        node = await renv.client.get(Node, "gke-kaito-g0-w0")
+        set_node_condition(node, "AcceleratorHealthy", "False",
+                           reason="HardwareFault")
+        await renv.client.update_status(node)
+
+        await asyncio.wait_for(crashes.crashed.wait(), 15)
+        assert crashes.fired["mid_repair"] >= 1
+        deletes_before_restart = renv.cloud.nodepools.calls["begin_delete"]
+        assert deletes_before_restart == 0, "claim deleted before the crash"
+
+        crashes.disarm()      # the next incarnation runs clean
+        await renv.restart()
+        await renv.wait_gone("g0", timeout=20)  # repair completes exactly once
+        # KAITO recreates the repaired claim; identity must re-converge
+        await renv.client.create(_claim("g0", "tpu-v5e-16", "g"))
+        await renv.wait_ready("g0", timeout=25)
+
+        assert renv.cloud.nodepools.calls["begin_delete"] == 1, \
+            "repair double-deleted through the restart"
+        g1 = await renv.client.get(NodeClaim, "g1")
+        assert g1.metadata.uid == g1_uid, "healthy group member was replaced"
+
+        async def coordinator_restamped():
+            nodes = await renv.client.list(
+                Node, labels={wk.TPU_SLICE_GROUP_LABEL: "g"})
+            coords = {n.metadata.labels.get(wk.TPU_COORDINATOR_LABEL)
+                      for n in nodes}
+            return (len(nodes) == 4 and coords == {"gke-kaito-g0-w0"})
+        deadline = asyncio.get_event_loop().time() + 10
+        while not await coordinator_restamped():
+            assert asyncio.get_event_loop().time() < deadline, \
+                "slice-group coordinator never re-stamped after repair"
+            await asyncio.sleep(0.05)
+        pools = set(renv.cloud.nodepools.pools)
+        assert pools == {"g0", "g1"}, pools
+    finally:
+        await renv.crash()
+
+
+# ------------------------------------------- slice-group coordinator hygiene
+
+@async_test
+async def test_stale_coordinator_label_cleared_while_slice0_absent():
+    """While slice 0 is gone (mid-repair window), the group's nodes must not
+    keep advertising the dead coordinator — the label is stripped, then
+    re-stamped once a replacement takes index 0."""
+    async with Env(EnvtestOptions()) as env:
+        for name in ("s0", "s1"):
+            await env.client.create(_claim(name, "tpu-v5e-16", "g2"))
+        for name in ("s0", "s1"):
+            await env.wait_ready(name, timeout=15)
+        await env.client.delete(NodeClaim, "s0")
+        await env.wait_gone("s0", timeout=15)
+
+        async def coordinator_dropped():
+            nodes = await env.client.list(
+                Node, labels={wk.TPU_SLICE_GROUP_LABEL: "g2"})
+            return nodes and all(
+                wk.TPU_COORDINATOR_LABEL not in n.metadata.labels
+                for n in nodes)
+        deadline = asyncio.get_event_loop().time() + 10
+        while not await coordinator_dropped():
+            assert asyncio.get_event_loop().time() < deadline, \
+                "stale coordinator label survived slice-0 deletion"
+            await asyncio.sleep(0.05)
+
+
+# --------------------------------------------------------- repair hygiene
+
+@async_test
+async def test_never_heartbeated_kubelet_caught_by_persistent_anchor():
+    """A kubelet that dies before its FIRST status report leaves
+    ``lastHeartbeatTime=None`` forever. The (node, "hb") observed-since
+    anchor used to be popped with the condition anchors on every healthy
+    reconcile, restarting the clock each pass so the bound could never
+    elapse — the anchor must survive healthy passes (nothing here ever
+    stamps a heartbeat, so repair firing proves it did)."""
+    before = _stats()
+    async with repair_env(repair_heartbeat_bound=1.5) as env:
+        await env.client.create(make_nodeclaim("hb0"))
+        await env.wait_ready("hb0", timeout=15)
+        node = await env.client.get(Node, "gke-kaito-hb0-w0")
+        assert node.ready_condition().last_heartbeat_time is None
+        await env.wait_gone("hb0", timeout=10)
+        assert _stats()["succeeded"] > before["succeeded"]
+
+
+@async_test
+async def test_replacement_node_with_new_uid_resets_flap_history():
+    """A repaired claim's replacement node reuses the SAME name; when the
+    delete and add watch events coalesce in the workqueue, no NotFound
+    reconcile ever runs ``_forget`` — the uid flip must reset the per-node
+    condition history so the healthy replacement isn't insta-diagnosed with
+    its predecessor's flaps and wrongly repaired."""
+    from collections import deque
+
+    from gpu_provisioner_tpu.fake.builders import make_node
+    from gpu_provisioner_tpu.runtime import Request
+
+    class CP:
+        def repair_policies(self):
+            return []
+
+    node = make_node("r1", ready=True)
+    node.metadata.uid = "uid-old"
+
+    class StubClient:
+        async def get(self, cls, name, namespace=""):
+            return node
+
+    hc = NodeHealthController(
+        StubClient(), CP(),
+        options=HealthOptions(flap_threshold=3, flap_window=600.0,
+                              max_unhealthy_fraction=0.0, max_cache_age=0.0))
+    mono = asyncio.get_event_loop().time()
+    hc._node_uid["r1"] = "uid-old"
+    hc._transitions["r1"] = deque([mono] * 3)
+    hc._flapping.add("r1")
+    node.metadata.uid = "uid-new"
+    await hc.reconcile(Request(name="r1"))
+    assert "r1" not in hc._flapping, \
+        "replacement node inherited its predecessor's flap verdict"
+    assert not hc._transitions.get("r1"), "flap history survived the uid flip"
+    assert hc._node_uid["r1"] == "uid-new"
+
+
+@async_test
+async def test_breaker_counts_flapping_and_silent_nodes():
+    """Flapping and silently-dead nodes both read Ready=True at list time;
+    the breaker numerator must still see them or the mass-delete protection
+    never engages for exactly the fault classes this PR introduces."""
+    from gpu_provisioner_tpu.fake.builders import make_node
+
+    class CP:
+        def repair_policies(self):
+            return []
+
+    nodes = [make_node(f"n{i}", ready=True) for i in range(4)]
+
+    class StubClient:
+        async def list(self, cls, labels=None, **kw):
+            return nodes
+
+    hc = NodeHealthController(
+        StubClient(), CP(),
+        options=HealthOptions(max_unhealthy_fraction=0.5,
+                              breaker_min_unhealthy=2, breaker_ttl=0.0))
+    assert not await hc._circuit_broken(0.0)
+    hc._flapping.update({"n0", "n1", "n2"})
+    assert await hc._circuit_broken(1.0), \
+        "a fleet-wide flap storm is invisible to the breaker"
+
+
+# --------------------------------------------------------- silent death
+
+@async_test
+async def test_silent_kubelet_death_repaired_via_stale_heartbeat():
+    """The fault no watch event announces: heartbeats stop while Ready stays
+    a stale True. The stale-heartbeat policy (with its healthy-node re-poll
+    cadence) is the only path that can see it."""
+    before = _stats()
+    inj = chaos.node_fault_profile("silent_death", seed=SEED, duration=20.0)
+    async with repair_env(inj, repair_heartbeat_bound=0.5) as env:
+        await env.client.create(make_nodeclaim("sd0"))
+        await env.wait_ready("sd0", timeout=15)
+        # Ready still True on the victim; nothing flips the condition
+        node = await env.client.get(Node, "gke-kaito-sd0-w0")
+        assert node.is_ready()
+        await env.wait_gone("sd0", timeout=15)
+        assert inj.injected_total("silent:") >= 1
+        assert _stats()["succeeded"] > before["succeeded"]

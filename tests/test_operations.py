@@ -111,6 +111,22 @@ async def test_tracker_idles_without_operations():
 
 
 @async_test
+async def test_tracker_poller_exits_on_stop_flag_even_when_cancel_is_eaten():
+    """py3.10's wait_for can swallow a cancellation that races a completed
+    inner future (bpo-42130), leaving the poller alive and parked on _wake
+    while stop() awaits it forever (Env teardown hang, seen flakily under
+    repair-churn teardown). The stop flag + wake must terminate the loop
+    WITHOUT relying on the cancel being delivered."""
+    kube, cloud, provider, tracker = await _tracked_env()
+    await asyncio.sleep(0)          # let the poller park on _wake
+    # simulate the eaten cancel: no task.cancel() at all — flag + wake only
+    tracker._stopping = True
+    tracker._wake.set()
+    await asyncio.wait_for(tracker._task, 2.0)
+    tracker._task = None            # consumed; nothing left for stop()
+
+
+@async_test
 async def test_create_registers_then_completes_via_batched_list():
     kube, cloud, provider, tracker = await _tracked_env()
     try:
