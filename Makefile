@@ -17,7 +17,30 @@ help: ## Show this help
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
 	  awk 'BEGIN {FS = ":.*?## "}; {printf "  %-24s %s\n", $$1, $$2}'
 
-## -------- test / bench ----------------------------------------------------
+## -------- lint / test / bench ---------------------------------------------
+
+# The baseline layer (ruff/mypy) is ADVISORY until the configs have been
+# validated in an image that ships the tools — the dev container doesn't,
+# so a committed-but-unexecuted config must not be able to brick `make
+# verify` on pre-existing code. Flip LINT_BASELINE_STRICT=1 once validated.
+LINT_BASELINE_STRICT ?= 0
+
+.PHONY: lint
+lint: ## Static analysis: ruff + mypy (advisory baseline when installed) + provlint (docs/STATIC_ANALYSIS.md)
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+	  $(PY) -m ruff check gpu_provisioner_tpu tests \
+	    || { echo "lint: ruff baseline found issues"; \
+	         [ "$(LINT_BASELINE_STRICT)" = "1" ] && exit 1 || true; }; \
+	else echo "lint: ruff not installed; skipping baseline layer"; fi
+	@if $(PY) -m mypy --version >/dev/null 2>&1; then \
+	  $(PY) -m mypy gpu_provisioner_tpu/runtime gpu_provisioner_tpu/providers \
+	    || { echo "lint: mypy baseline found issues"; \
+	         [ "$(LINT_BASELINE_STRICT)" = "1" ] && exit 1 || true; }; \
+	else echo "lint: mypy not installed; skipping baseline layer"; fi
+	$(PY) -m gpu_provisioner_tpu.analysis gpu_provisioner_tpu tests
+
+.PHONY: verify
+verify: lint unit-test ## Default verify path: static analysis, then the unit suites
 
 .PHONY: unit-test
 unit-test: ## Unit tests (reference Makefile:171-175)

@@ -72,7 +72,12 @@ async def bench_provisioning(n_claims: int, shape: str,
                           termination=TerminationOptions(
                               requeue=1.0, instance_requeue=1.0),
                           max_concurrent_reconciles=2048,
-                          use_informer=True)
+                          use_informer=True,
+                          # measurement harness at deliberate saturation:
+                          # scheduling-latency spikes are the thing being
+                          # measured, not a defect — keep the leak gate,
+                          # drop the stall gate
+                          stall_budget=0.0)
     resolved = catalog.lookup(shape)
     if resolved is None:
         raise SystemExit(f"unknown TPU shape {shape!r} (try tpu-v5e-8, v5p-32)")

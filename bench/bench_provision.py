@@ -187,7 +187,9 @@ async def bench_wave(n_claims: int, shape: str = "tpu-v5e-8") -> dict:
         lifecycle=LifecycleOptions(termination_requeue=0.5,
                                    registration_requeue=0.5),
         termination=TerminationOptions(requeue=0.5, instance_requeue=0.5),
-        max_concurrent_reconciles=1024, use_informer=True)
+        max_concurrent_reconciles=1024, use_informer=True,
+        # measurement at saturation: stall gate off, leak gate stays on
+        stall_budget=0.0)
     async with Env(opts) as env:
         async def provision(i: int) -> float:
             t = time.perf_counter()
@@ -258,7 +260,9 @@ async def bench_constrained_wave(n_claims: int = 200, workers: int = 8,
         lifecycle=LifecycleOptions(termination_requeue=0.2,
                                    registration_requeue=0.2,
                                    inprogress_requeue=0.2),
-        termination=TerminationOptions(requeue=0.2, instance_requeue=0.2))
+        termination=TerminationOptions(requeue=0.2, instance_requeue=0.2),
+        # measurement at saturation: stall gate off, leak gate stays on
+        stall_budget=0.0)
     async with Env(opts) as env:
         # pinned-worker-seconds: total wall time lifecycle workers spend
         # INSIDE reconcile — the resource the blocking shape burns (a

@@ -1,0 +1,30 @@
+"""provlint: project-specific static analysis + runtime detectors.
+
+PRs 1-5 hardened the provisioner with invariants that lived only in review
+comments: fence checks before cloud mutations (PR 3), never swallowing
+``asyncio.CancelledError``/``SimulatedCrash`` (the PR 5 bpo-42130 teardown
+hang), injected clocks in controllers, a never-blocked event loop (the
+BENCH_NOTES r04/r05 scaling ceiling), tracked background tasks (the PR 4/5
+tracker-poller bug class). This package makes them mechanical:
+
+- :mod:`.provlint` — the AST engine: rule registry, the inline-waiver
+  comment syntax (``provlint: disable=<rule> — <reason>``), file walking,
+  CLI.
+- :mod:`.rules` — the project rule catalog (see docs/STATIC_ANALYSIS.md).
+- :mod:`.detectors` — runtime enforcement wired into envtest: the
+  event-loop stall detector and the background task/thread leak gate.
+
+Run it: ``python -m gpu_provisioner_tpu.analysis [paths...]`` or
+``make lint``.
+"""
+
+from .detectors import (
+    EventLoopStallError, StallDetector, TaskLeakError, ThreadLeakError,
+)
+from .provlint import Finding, lint_file, lint_paths, main
+from .rules import RULES
+
+__all__ = [
+    "EventLoopStallError", "Finding", "RULES", "StallDetector",
+    "TaskLeakError", "ThreadLeakError", "lint_file", "lint_paths", "main",
+]

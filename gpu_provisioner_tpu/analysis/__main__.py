@@ -1,0 +1,8 @@
+"""``python -m gpu_provisioner_tpu.analysis`` — run provlint."""
+
+import sys
+
+from .provlint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Runtime detectors: the dynamic half of provlint, wired into envtest.
+
+Static rules catch the *patterns* that block the loop or leak tasks; these
+detectors catch the *instances* the rules can't see (blocking work behind a
+seam, a task spawned by third-party code, a teardown path that forgot one
+component). Both are armed by default in :class:`~..envtest.Env`:
+
+- :class:`StallDetector` — a sentinel coroutine sleeps ``interval`` seconds
+  and measures how late the loop woke it. Oversleep beyond scheduler noise
+  means something held the loop — ``time.sleep``, sync I/O, a pathological
+  CPU section. The worst stall is checked against a budget at Env teardown
+  and raises :class:`EventLoopStallError` (BENCH_NOTES r04/r05: the single
+  event loop IS the scaling ceiling; blocking it is the one unforgivable
+  sin here).
+- Task/thread leak gate — the PR 4 tracker-only "poller outlived its Env"
+  check, generalized: every component's background-task seam is enumerated
+  at teardown and any survivor raises :class:`TaskLeakError`
+  (:class:`ThreadLeakError` for threads). Scoped to the Env's OWN
+  components so a RestartableEnv zombie's rival incarnation — deliberately
+  kept alive in failover soaks — never false-positives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Iterable, Optional
+
+
+class EventLoopStallError(RuntimeError):
+    """The event loop was blocked longer than the stall budget."""
+
+
+class TaskLeakError(RuntimeError):
+    """A component's background task outlived its Env."""
+
+
+class ThreadLeakError(RuntimeError):
+    """A non-daemon thread started during the Env outlived it."""
+
+
+class StallDetector:
+    """Measure event-loop responsiveness via sentinel-sleep overshoot.
+
+    ``worst`` is the largest observed stall (seconds the loop was held
+    beyond the sentinel's requested sleep); ``stalls`` records every
+    observation above ``budget``. ``check()`` raises when the budget was
+    exceeded — callers decide *when* to fail (envtest: at teardown, so the
+    stall surfaces as a test failure with the worst offender's timing).
+    """
+
+    def __init__(self, budget: float = 1.0, interval: float = 0.05):
+        self.budget = budget
+        self.interval = interval
+        self.worst = 0.0
+        self.stalls: list[tuple[float, float]] = []   # (loop time, lag)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(
+                self._run(), name="provlint-stall-detector")
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
+        last = loop.time()
+        while True:
+            await asyncio.sleep(self.interval)
+            now = loop.time()
+            lag = now - last - self.interval
+            if lag > self.worst:
+                self.worst = lag
+            if lag > self.budget:
+                self.stalls.append((now, lag))
+            last = now
+
+    def check(self) -> None:
+        if self.worst > self.budget:
+            raise EventLoopStallError(
+                f"event loop blocked for {self.worst:.3f}s "
+                f"(budget {self.budget:.3f}s, {len(self.stalls)} stall(s) "
+                f"over budget) — something ran sync work on the loop; see "
+                f"docs/STATIC_ANALYSIS.md (stall detector)")
+
+
+def _task_label(task: asyncio.Task) -> str:
+    name = task.get_name()
+    coro = getattr(task, "get_coro", lambda: None)()
+    code = getattr(coro, "cr_code", None)
+    where = f" ({code.co_filename}:{code.co_firstlineno})" if code else ""
+    return f"{name}{where}"
+
+
+def alive_tasks(named: Iterable[tuple[str, Optional[asyncio.Task]]]
+                ) -> list[str]:
+    """Filter a (component, task) enumeration down to survivors, rendered
+    for the error message."""
+    return [f"{component}: {_task_label(t)}"
+            for component, t in named
+            if t is not None and not t.done()]
+
+
+def check_no_leaked_tasks(named: Iterable[tuple[str, Optional[asyncio.Task]]],
+                          who: str = "Env") -> None:
+    leaked = alive_tasks(named)
+    if leaked:
+        raise TaskLeakError(
+            f"{len(leaked)} background task(s) outlived their {who}: "
+            + "; ".join(leaked))
+
+
+def thread_snapshot() -> set[int]:
+    return {t.ident for t in threading.enumerate() if t.ident is not None}
+
+
+def check_no_leaked_threads(before: set[int], who: str = "Env") -> None:
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.is_alive() and not t.daemon]
+    if leaked:
+        raise ThreadLeakError(
+            f"{len(leaked)} non-daemon thread(s) started during the {who} "
+            f"outlived it: {[t.name for t in leaked]}")

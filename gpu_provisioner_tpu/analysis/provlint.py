@@ -1,0 +1,383 @@
+"""provlint engine: rule registry, waivers, file walking, CLI.
+
+Design notes
+------------
+Each rule is a pure function over one parsed module (``RuleContext``) —
+rules never do I/O, so the whole suite runs in one pass per file and the
+fixture corpus (tests/analysis_fixtures/) can drive any rule against any
+snippet regardless of where the snippet lives on disk.
+
+Rules are *scoped by role*: a file under ``gpu_provisioner_tpu/controllers``
+has roles ``{"package", "controllers"}``, test files have ``{"tests"}``, and
+a rule only runs where its invariant applies (wall-clock discipline is a
+controller rule; sleep-poll discipline a test rule). ``lint_file`` accepts a
+``roles`` override so fixture tests can force a role.
+
+Waivers are inline comments::
+
+    do_the_thing()  # provlint: disable=naked-wall-clock — bench baseline
+
+The separator is an em dash (``—``) or ``--``; the reason is MANDATORY — a
+waiver without one (or naming an unknown rule) is itself a finding
+(``PL000 malformed-waiver``). A trailing waiver suppresses the named rules
+on its own line and the line directly below (multi-line statements); a
+comment-only waiver suppresses exactly the next code line — never the one
+after it. ``disable-file=`` waives for the whole file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import re
+import sys
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+# Roles a file can have; rules declare which they run under.
+ROLE_PACKAGE = "package"          # anywhere under gpu_provisioner_tpu/
+ROLE_CONTROLLERS = "controllers"
+ROLE_PROVIDERS = "providers"
+ROLE_RUNTIME = "runtime"
+ROLE_CLOUDPROVIDER = "cloudprovider"
+ROLE_CHAOS = "chaos"
+ROLE_TESTS = "tests"
+
+# Deliberate-violation corpus for the rule tests; never linted by default.
+FIXTURE_DIR = "analysis_fixtures"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # "PL004"
+    name: str          # "naked-wall-clock"
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.name}] {self.message}"
+
+
+class Imports:
+    """Module import table for dotted-name resolution.
+
+    ``import time as t`` maps ``t`` → ``time``; ``from datetime import
+    datetime`` maps ``datetime`` → ``datetime.datetime`` — so
+    ``dotted(node)`` on ``datetime.now`` resolves to
+    ``datetime.datetime.now`` no matter how the module was imported.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: dict[str, str] = {}
+        self.from_names: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    self.from_names[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name)
+
+    def resolve(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        if head in self.from_names:
+            head = self.from_names[head]
+        elif head in self.aliases:
+            head = self.aliases[head]
+        return f"{head}.{rest}" if rest else head
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class RuleContext:
+    path: str                      # display path (repo-relative when possible)
+    roles: frozenset
+    source: str
+    lines: list[str]
+    tree: ast.Module
+    imports: Imports
+
+    def resolved(self, node: ast.AST) -> Optional[str]:
+        d = dotted_name(node)
+        return self.imports.resolve(d) if d is not None else None
+
+    def functions(self) -> Iterable[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+def body_walk(node: ast.AST, *, into_nested_defs: bool = False):
+    """Walk a function body without descending into nested function/class
+    definitions (their bodies execute in a different context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not into_nested_defs and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                    ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    roles: frozenset
+    doc: str
+    fn: Callable[[RuleContext], list[tuple[int, str]]]
+
+    def run(self, ctx: RuleContext) -> list[Finding]:
+        if self.roles and not (self.roles & ctx.roles):
+            return []
+        return [Finding(self.id, self.name, ctx.path, line, msg)
+                for line, msg in self.fn(ctx)]
+
+
+# ------------------------------------------------------------------ waivers
+
+_WAIVER_RE = re.compile(
+    r"#\s*provlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_\-, ]+?)\s*(?:—|--)\s*(\S.*)$")
+_WAIVER_MARK_RE = re.compile(r"#\s*provlint\s*:")
+
+
+@dataclasses.dataclass
+class Waivers:
+    by_line: dict[int, set[str]]      # trailing waiver: its line (+ next)
+    exact: dict[int, set[str]]        # comment-only waiver: ONE code line
+    file_wide: set[str]
+    malformed: list[tuple[int, str]]  # (line, message) → PL000 findings
+
+    def waived(self, rule: Rule, line: int) -> bool:
+        keys = {rule.id.lower(), rule.name.lower()}
+        if keys & self.file_wide:
+            return True
+        if keys & self.exact.get(line, set()):
+            return True
+        # a trailing waiver covers its own line and the line directly
+        # below it (multi-line statements); comment-only waivers are
+        # EXACT — they must not bleed onto the line after their target
+        for at in (line, line - 1):
+            if keys & self.by_line.get(at, set()):
+                return True
+        return False
+
+
+def _comment_lines(source: str) -> Optional[set[int]]:
+    """Line numbers carrying a real COMMENT token — waiver syntax quoted in
+    a docstring/string literal must neither waive nor count as malformed.
+    None when the file fails to tokenize (caller falls back to line scan)."""
+    try:
+        return {tok.start[0]
+                for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT}
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+
+
+def parse_waivers(lines: list[str], known: set[str],
+                  comment_lines: Optional[set[int]] = None) -> Waivers:
+    by_line: dict[int, set[str]] = {}
+    exact: dict[int, set[str]] = {}
+    file_wide: set[str] = set()
+    malformed: list[tuple[int, str]] = []
+    for i, text in enumerate(lines, start=1):
+        if comment_lines is not None and i not in comment_lines:
+            continue
+        if not _WAIVER_MARK_RE.search(text):
+            continue
+        m = _WAIVER_RE.search(text)
+        if m is None:
+            malformed.append((i, (
+                "malformed waiver: expected disable=<rule> — <reason> "
+                "after the provlint marker (the reason is mandatory)")))
+            continue
+        kind, rules_raw, _reason = m.groups()
+        keys = {r.strip().lower() for r in rules_raw.split(",") if r.strip()}
+        unknown = keys - known
+        if unknown:
+            malformed.append((i, (
+                f"waiver names unknown rule(s): {sorted(unknown)}")))
+            keys -= unknown
+        if kind == "disable-file":
+            file_wide |= keys
+            continue
+        if text.lstrip().startswith("#"):
+            # comment-only waiver: cover exactly the next CODE line,
+            # skipping the rest of its own comment block (reasons often
+            # wrap) — and nothing past it
+            j = i + 1
+            while j <= len(lines) and (
+                    not lines[j - 1].strip()
+                    or lines[j - 1].lstrip().startswith("#")):
+                j += 1
+            exact.setdefault(j, set()).update(keys)
+        else:
+            by_line.setdefault(i, set()).update(keys)
+    return Waivers(by_line, exact, file_wide, malformed)
+
+
+# ------------------------------------------------------------- role mapping
+
+def infer_roles(path: Path) -> frozenset:
+    parts = path.parts
+    roles: set[str] = set()
+    if "gpu_provisioner_tpu" in parts:
+        roles.add(ROLE_PACKAGE)
+        # LAST occurrence: a checkout directory named like the package
+        # (~/gpu_provisioner_tpu/gpu_provisioner_tpu/controllers/...) must
+        # not shadow the package dir and silently drop the sub-roles —
+        # that would disable the control-plane rules with zero findings
+        idx = len(parts) - 1 - parts[::-1].index("gpu_provisioner_tpu")
+        sub = parts[idx + 1] if len(parts) > idx + 1 else ""
+        if sub in (ROLE_CONTROLLERS, ROLE_PROVIDERS, ROLE_RUNTIME,
+                   ROLE_CLOUDPROVIDER, ROLE_CHAOS):
+            roles.add(sub)
+    if "tests" in parts:
+        roles.add(ROLE_TESTS)
+    return frozenset(roles)
+
+
+# ------------------------------------------------------------------- runner
+
+def _known_keys(rules: list[Rule]) -> set[str]:
+    keys: set[str] = set()
+    for r in rules:
+        keys.add(r.id.lower())
+        keys.add(r.name.lower())
+    return keys
+
+
+def lint_file(path: Path, rules: Optional[list[Rule]] = None,
+              roles: Optional[frozenset] = None,
+              display_path: Optional[str] = None) -> list[Finding]:
+    from .rules import RULES
+    rules = RULES if rules is None else rules
+    path = Path(path)
+    display = display_path or _display(path)
+    source = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding("PL000", "malformed-waiver", display,
+                        e.lineno or 0, f"file does not parse: {e.msg}")]
+    lines = source.splitlines()
+    ctx = RuleContext(
+        path=display,
+        roles=roles if roles is not None else infer_roles(path.resolve()),
+        source=source, lines=lines, tree=tree, imports=Imports(tree))
+    # waiver validity is judged against the FULL catalog, not any --select
+    # subset — a waiver naming an unselected rule is not malformed
+    from .rules import RULES as _ALL_RULES
+    waivers = parse_waivers(lines, _known_keys(_ALL_RULES),
+                            _comment_lines(source))
+    findings = [Finding("PL000", "malformed-waiver", display, line, msg)
+                for line, msg in waivers.malformed]
+    for rule in rules:
+        for f in rule.run(ctx):
+            if not waivers.waived(rule, f.line):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def _display(path: Path) -> str:
+    try:
+        return str(path.resolve().relative_to(Path.cwd()))
+    except ValueError:
+        return str(path)
+
+
+def iter_py_files(paths: Iterable[Path]) -> Iterable[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if FIXTURE_DIR in f.parts:
+                    continue  # deliberate-violation corpus
+                yield f
+
+
+def lint_paths(paths: Iterable[Path],
+               rules: Optional[list[Rule]] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f, rules=rules))
+    return findings
+
+
+# ---------------------------------------------------------------------- CLI
+
+def main(argv: Optional[list[str]] = None) -> int:
+    from .rules import RULES
+    ap = argparse.ArgumentParser(
+        prog="provlint",
+        description="Project-specific static analysis for the provisioner "
+                    "control plane (docs/STATIC_ANALYSIS.md).")
+    ap.add_argument("paths", nargs="*", default=["gpu_provisioner_tpu",
+                                                 "tests"],
+                    help="files or directories to lint")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE", help="run only these rules (id or name)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            roles = ",".join(sorted(r.roles)) or "all"
+            print(f"{r.id}  {r.name:<28} [{roles}]  {r.doc}")
+        return 0
+
+    rules = RULES
+    if args.select:
+        keys = {s.lower() for s in args.select}
+        rules = [r for r in RULES
+                 if r.id.lower() in keys or r.name.lower() in keys]
+        if not rules:
+            print(f"provlint: no rule matches {sorted(keys)}",
+                  file=sys.stderr)
+            return 2
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"provlint: no such path: {missing}", file=sys.stderr)
+        return 2
+
+    files = list(iter_py_files(Path(p) for p in args.paths))
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, rules=rules))
+    if args.as_json:
+        print(json.dumps([dataclasses.asdict(f) for f in findings],
+                         indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"provlint: {len(findings)} finding(s) across {len(files)} "
+              f"file(s), {len(rules)} rule(s) active", file=sys.stderr)
+    return 1 if findings else 0

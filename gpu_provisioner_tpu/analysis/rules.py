@@ -1,0 +1,514 @@
+"""The provlint rule catalog.
+
+Each rule encodes an invariant a previous PR paid for the hard way; the
+rationale (and the PR that motivated each) is in docs/STATIC_ANALYSIS.md.
+Rules are heuristics over one module's AST — deliberately simple enough to
+read, with the inline-waiver syntax as the escape hatch for the places a
+human can see further than the heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .provlint import (
+    ROLE_CHAOS, ROLE_CLOUDPROVIDER, ROLE_CONTROLLERS, ROLE_PACKAGE,
+    ROLE_PROVIDERS, ROLE_RUNTIME, ROLE_TESTS,
+    Rule, RuleContext, body_walk, dotted_name,
+)
+
+_ASYNC_ROLES = frozenset({ROLE_CONTROLLERS, ROLE_PROVIDERS, ROLE_RUNTIME})
+
+
+# --------------------------------------------------- PL001 blocking-in-async
+
+_BLOCKING_CALLS = {
+    "time.sleep", "os.system", "os.popen", "socket.create_connection",
+    "socket.getaddrinfo", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.Popen",
+}
+_BLOCKING_PREFIXES = ("requests.", "urllib.request.", "urllib3.",
+                      "http.client.")
+
+
+def _async_functions(ctx: RuleContext):
+    for fn in ctx.functions():
+        if isinstance(fn, ast.AsyncFunctionDef):
+            yield fn
+
+
+def check_blocking_in_async(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in _async_functions(ctx):
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = ctx.resolved(node.func)
+            if d is None:
+                continue
+            if (d in _BLOCKING_CALLS or d.startswith(_BLOCKING_PREFIXES)
+                    or d == "open"):
+                out.append((node.lineno, (
+                    f"blocking call {d}() inside async def "
+                    f"{fn.name!r} — this parks the single event loop "
+                    f"every reconcile shares; use the async seam "
+                    f"(asyncio.sleep / asyncio.to_thread / httpx)")))
+    return out
+
+
+# ----------------------------------------------- PL002 cancellation-swallow
+
+_MUST_RERAISE_LAST = {"CancelledError", "SimulatedCrash", "BaseException",
+                      "KeyboardInterrupt", "SystemExit"}
+
+
+def _caught_names(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return ["BaseException"]
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for n in nodes:
+        d = dotted_name(n)
+        if d is not None:
+            names.append(d.rsplit(".", 1)[-1])
+    return names
+
+
+def _is_task_reap_try(try_node: ast.Try) -> bool:
+    """The standard teardown shape — ``t.cancel(); try: await t except
+    CancelledError: pass`` — swallows the task's *own* cancellation, which
+    is correct; only a handler that can eat the CURRENT task's cancellation
+    is a hang risk. Recognized by the try body being nothing but awaits of
+    plain names/attributes (no calls: the awaited thing already exists)."""
+    for stmt in try_node.body:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Await)
+                and isinstance(stmt.value.value, (ast.Name, ast.Attribute))):
+            return False
+    return bool(try_node.body)
+
+
+def check_cancellation_swallow(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        reap = _is_task_reap_try(node)
+        for handler in node.handlers:
+            caught = set(_caught_names(handler)) & _MUST_RERAISE_LAST
+            if not caught:
+                continue
+            if reap and caught <= {"CancelledError"}:
+                continue
+            if any(isinstance(n, ast.Raise) for n in body_walk(handler)):
+                continue
+            out.append((handler.lineno, (
+                f"except catching {sorted(caught)} never re-raises — "
+                f"swallowing CancelledError/SimulatedCrash turns shutdown "
+                f"and crash injection into hangs (the PR 5 bpo-42130 bug "
+                f"class); re-raise, or narrow the except")))
+    return out
+
+
+# --------------------------------------------- PL003 unfenced-cloud-mutation
+
+_MUTATING_ATTRS = {"begin_create", "begin_delete"}
+_QUEUED_MUTATING_ATTRS = {"create", "delete"}
+_FENCE_CALLS = {"_fence_check", "check"}
+
+
+def _is_cloud_mutation(call: ast.Call) -> str | None:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _MUTATING_ATTRS:
+        return attr
+    if attr in _QUEUED_MUTATING_ATTRS:
+        chain = dotted_name(call.func) or ""
+        if "queued" in chain.lower():
+            return chain
+    return None
+
+
+def _is_fence_call(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _FENCE_CALLS:
+        return False
+    if call.func.attr == "_fence_check":
+        return True
+    chain = dotted_name(call.func) or ""
+    return "fence" in chain.lower()
+
+
+def check_unfenced_mutation(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    in_controllers = ROLE_CONTROLLERS in ctx.roles
+    for fn in ctx.functions():
+        fence_lines = []
+        mutations = []
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_fence_call(node):
+                fence_lines.append(node.lineno)
+            what = _is_cloud_mutation(node)
+            if what is not None:
+                mutations.append((node.lineno, what))
+        for line, what in mutations:
+            if in_controllers:
+                out.append((line, (
+                    f"controller calls cloud mutation {what} directly — "
+                    f"mutations must go through the provider seam, which "
+                    f"owns the fence check (PR 3 single-writer discipline)")))
+            elif not any(fl < line for fl in fence_lines):
+                out.append((line, (
+                    f"cloud mutation {what} with no preceding fence check "
+                    f"in this function — a deposed leader's in-flight "
+                    f"reconcile could race the new leader (PR 3); call "
+                    f"self._fence_check() (or fence.check()) first")))
+    return out
+
+
+# -------------------------------------------------- PL004 naked-wall-clock
+
+_WALL_CLOCKS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+
+def check_naked_wall_clock(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        # Attribute chains (time.monotonic) AND bare imported names
+        # (`from time import monotonic`) — the import style must not be
+        # the bypass. A Name inside an Attribute chain resolves to the
+        # bare module ("time"), never a clock, so nothing double-counts.
+        if not isinstance(node, (ast.Attribute, ast.Name)):
+            continue
+        if isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Load):
+            continue
+        d = ctx.resolved(node)
+        if d in _WALL_CLOCKS:
+            out.append((node.lineno, (
+                f"naked {d} in a controller — use the injected clock seams "
+                f"(asyncio loop time / providers.operations.loop_now for "
+                f"monotonic, apis.serde now()/wall_now() for wall time) so "
+                f"envtest and unit tests control time")))
+    return out
+
+
+# ------------------------------------------- PL005 metrics-registered-late
+
+_METRIC_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info",
+                        "Enum"}
+
+
+def check_metrics_registration(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in ctx.functions():
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = ctx.resolved(node.func)
+            if d is None:
+                continue
+            last = d.rsplit(".", 1)[-1]
+            is_prom = (d.startswith("prometheus_client.")
+                       and last in _METRIC_CONSTRUCTORS)
+            if is_prom or last == "_get_or_create":
+                out.append((node.lineno, (
+                    f"metric registered inside function {fn.name!r} — "
+                    f"prometheus collectors must be registered exactly once "
+                    f"at module scope (re-registration raises or silently "
+                    f"double-counts inside reconcile loops)")))
+    return out
+
+
+# ------------------------------------------- PL006 await-holding-sync-lock
+
+def check_await_holding_sync_lock(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):   # async with is fine
+            continue
+        lockish = None
+        for item in node.items:
+            expr = item.context_expr
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            d = dotted_name(target) or ""
+            if "lock" in d.lower():
+                lockish = d
+                break
+        if lockish is None:
+            continue
+        for inner in body_walk(node):
+            if isinstance(inner, ast.Await):
+                out.append((inner.lineno, (
+                    f"await while holding sync lock {lockish!r} — the loop "
+                    f"suspends with the lock held, and any other task "
+                    f"taking it blocks the whole event loop (deadlock "
+                    f"class); use asyncio.Lock with 'async with'")))
+                break
+    return out
+
+
+# ------------------------------------------------------ PL007 untracked-task
+
+_TASK_SPAWNS = {"asyncio.create_task", "asyncio.ensure_future"}
+
+
+def _spawn_call(ctx: RuleContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = ctx.resolved(node.func)
+    if d in _TASK_SPAWNS:
+        return True
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "create_task"
+            and "loop" in (dotted_name(node.func.value) or "").lower())
+
+
+def check_untracked_task(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    msg = ("background task is fire-and-forget — retain the handle and "
+           "track it for teardown (or add_done_callback), or it outlives "
+           "its owner and keeps polling dead state (the PR 4/PR 5 "
+           "tracker-poller bug class)")
+    for fn in ctx.functions():
+        assigned: list[tuple[str, ast.Assign]] = []
+        for node in body_walk(fn):
+            if isinstance(node, ast.Expr) and _spawn_call(ctx, node.value):
+                out.append((node.lineno, msg))
+            elif isinstance(node, ast.Assign) and _spawn_call(ctx, node.value):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    assigned.append((node.targets[0].id, node))
+        for name, assign in assigned:
+            # usage scan descends into nested defs: a handle retained via
+            # a closure/callback is tracked, not fire-and-forget (the
+            # Store-ctx assignment target is excluded by the Load check)
+            used = any(
+                isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)
+                for n in body_walk(fn, into_nested_defs=True)
+            )
+            if not used:
+                out.append((assign.lineno, msg))
+    return out
+
+
+# --------------------------------------------------- PL008 mutable-default
+
+def check_mutable_default(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    mutable_ctors = {"list", "dict", "set"}
+    for fn in ctx.functions():
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in mutable_ctors)
+            if bad:
+                out.append((d.lineno, (
+                    f"mutable default argument in {fn.name!r} — shared "
+                    f"across every call; use None and materialize inside")))
+    return out
+
+
+# ------------------------------------------------ PL009 ungated-crash-point
+
+def _has_crash_guard(fn: ast.AST) -> bool:
+    for node in body_walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        has_none = any(isinstance(s, ast.Constant) and s.value is None
+                       for s in sides)
+        names = " ".join(dotted_name(s) or "" for s in sides)
+        if has_none and "crash" in names.lower():
+            return True
+    return False
+
+
+def check_ungated_crash_point(ctx: RuleContext) -> list[tuple[int, str]]:
+    if ROLE_CHAOS in ctx.roles:
+        return []
+    out = []
+    layered = bool(ctx.roles & _ASYNC_ROLES | (ctx.roles & {ROLE_CLOUDPROVIDER}))
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and layered:
+            mod = node.module or ""
+            names = {a.name for a in node.names}
+            if ("chaos" in mod and names & {"SimulatedCrash", "CrashPoints"}):
+                out.append((node.lineno, (
+                    "controller/provider layer imports crash-injection "
+                    "types directly — these layers stay chaos-unaware; "
+                    "take a ``crashes`` object and gate on ``is not None`` "
+                    "(the _crash helper idiom)")))
+    for fn in ctx.functions():
+        for node in body_walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "hit"):
+                continue
+            chain = dotted_name(node.func) or ""
+            if "crash" not in chain.lower():
+                continue
+            if not _has_crash_guard(fn):
+                out.append((node.lineno, (
+                    f"crash point fired via {chain} without a "
+                    f"'crashes is None' gate in this function — production "
+                    f"passes no CrashPoints; guard the seam (the _crash "
+                    f"helper idiom) so the hot path costs one None check")))
+    return out
+
+
+# ---------------------------------------------- PL010 unbounded-sleep-poll
+
+_DEADLINEISH = re.compile(r"deadline|timeout|budget", re.IGNORECASE)
+
+
+def _mentions_deadline(fn: ast.AST) -> bool:
+    for node in body_walk(fn, into_nested_defs=True):
+        if isinstance(node, ast.Name) and _DEADLINEISH.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _DEADLINEISH.search(node.attr):
+            return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"):
+            return True
+    return False
+
+
+def check_unbounded_sleep_poll(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in _async_functions(ctx):
+        if _mentions_deadline(fn):
+            continue
+        for node in body_walk(fn):
+            if not isinstance(node, ast.While):
+                continue
+            sleeps = [
+                n for n in body_walk(node)
+                if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+                and ctx.resolved(n.value.func) == "asyncio.sleep"]
+            if sleeps:
+                out.append((node.lineno, (
+                    f"unbounded asyncio.sleep polling loop in {fn.name!r} "
+                    f"— envtest tests must poll against an explicit "
+                    f"deadline (the harness timeout turns this into a "
+                    f"60s-late, context-free failure)")))
+                break
+    return out
+
+
+# ------------------------------------------ PL011 unregistered-pytest-marker
+
+_BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "anyio",
+}
+_MARKER_LINE = re.compile(r'^\s*"([A-Za-z_][A-Za-z0-9_]*)\s*:')
+_marker_cache: dict[Path, frozenset] = {}
+
+
+def _registered_markers(start: Path) -> frozenset:
+    for parent in [start] + list(start.parents):
+        pp = parent / "pyproject.toml"
+        if not pp.is_file():
+            continue
+        if pp not in _marker_cache:
+            names, in_markers = set(), False
+            for line in pp.read_text(encoding="utf-8").splitlines():
+                s = line.strip()
+                if s.startswith("markers"):
+                    in_markers = True
+                    continue
+                if in_markers:
+                    if s.startswith("]"):
+                        break
+                    m = _MARKER_LINE.match(line)
+                    if m:
+                        names.add(m.group(1))
+            _marker_cache[pp] = frozenset(names)
+        return _marker_cache[pp]
+    return frozenset()
+
+
+def check_unregistered_marker(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    registered = _registered_markers(Path(ctx.path).resolve().parent)
+    allowed = registered | _BUILTIN_MARKERS
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        d = dotted_name(node) or ""
+        if d.startswith("pytest.mark.") and d.count(".") == 2:
+            name = d.rsplit(".", 1)[-1]
+            if name not in allowed:
+                out.append((node.lineno, (
+                    f"pytest marker {name!r} is not registered in "
+                    f"pyproject.toml [tool.pytest.ini_options] markers — "
+                    f"unregistered markers warn at collection and break "
+                    f"-W error::DeprecationWarning runs")))
+    return out
+
+
+# ----------------------------------------------------------------- catalog
+
+RULES: list[Rule] = [
+    Rule("PL001", "blocking-in-async", _ASYNC_ROLES,
+         "no time.sleep / sync HTTP / sync file I/O inside async defs in "
+         "the control plane (BENCH r04/r05: one blocked loop stalls every "
+         "reconcile)", check_blocking_in_async),
+    Rule("PL002", "cancellation-swallow",
+         frozenset({ROLE_PACKAGE, ROLE_TESTS}),
+         "except clauses that can catch CancelledError/SimulatedCrash must "
+         "re-raise (PR 5 bpo-42130 teardown hang; PR 3 crash realism)",
+         check_cancellation_swallow),
+    Rule("PL003", "unfenced-cloud-mutation",
+         frozenset({ROLE_PROVIDERS, ROLE_CONTROLLERS}),
+         "cloud mutations (begin_create/begin_delete/queued writes) need a "
+         "preceding fence check on the same path; controllers never call "
+         "them directly (PR 3 single-writer discipline)",
+         check_unfenced_mutation),
+    Rule("PL004", "naked-wall-clock", frozenset({ROLE_CONTROLLERS}),
+         "controllers use the injected clock seams, never "
+         "time.time/monotonic/datetime.now (PR 5 observed-staleness "
+         "anchoring; deterministic envtest time)", check_naked_wall_clock),
+    Rule("PL005", "metrics-registered-late", frozenset({ROLE_PACKAGE}),
+         "prometheus collectors are registered exactly once at module "
+         "scope, never inside functions/reconcile loops (PR 1 metrics "
+         "surface)", check_metrics_registration),
+    Rule("PL006", "await-holding-sync-lock", frozenset({ROLE_PACKAGE}),
+         "no await while holding a non-async lock (lock held across a "
+         "suspension point blocks the whole loop)",
+         check_await_holding_sync_lock),
+    Rule("PL007", "untracked-task", frozenset({ROLE_PACKAGE}),
+         "every asyncio.create_task/ensure_future result is retained and "
+         "tracked for teardown (PR 4 tracker-poller leak class)",
+         check_untracked_task),
+    Rule("PL008", "mutable-default", _ASYNC_ROLES | {ROLE_CLOUDPROVIDER},
+         "no mutable default arguments in control-plane signatures",
+         check_mutable_default),
+    Rule("PL009", "ungated-crash-point",
+         frozenset({ROLE_PACKAGE}),
+         "crash points fire only through a None-gated seam; control-plane "
+         "layers never import crash types (PR 3 chaos layering)",
+         check_ungated_crash_point),
+    Rule("PL010", "unbounded-sleep-poll", frozenset({ROLE_TESTS}),
+         "test polling loops carry an explicit deadline, not bare "
+         "asyncio.sleep spins", check_unbounded_sleep_poll),
+    Rule("PL011", "unregistered-pytest-marker", frozenset({ROLE_TESTS}),
+         "pytest markers used in tests are registered in pyproject.toml",
+         check_unregistered_marker),
+]

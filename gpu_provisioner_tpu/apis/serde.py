@@ -26,6 +26,15 @@ def now() -> datetime:
     return datetime.now(timezone.utc).replace(microsecond=0)
 
 
+def wall_now() -> datetime:
+    """UTC now at full precision — the wall-clock seam for age computations
+    against sub-second data timestamps (e.g. heartbeat staleness), where
+    ``now()``'s metav1 truncation would under-report ages by up to a
+    second. Controllers use this (or ``now()``) rather than naked
+    ``datetime.now`` so the clock stays a seam (provlint PL004)."""
+    return datetime.now(timezone.utc)
+
+
 def fmt_time(t: datetime) -> str:
     return t.astimezone(timezone.utc).strftime(RFC3339)
 

@@ -251,7 +251,7 @@ def node_fault_profile(name: str, seed: int = 0, **overrides) -> NodeFaultInject
         factory = NODE_FAULT_PROFILES[name]
     except KeyError:
         raise ValueError(f"unknown node-fault profile {name!r}; "
-                         f"known: {sorted(NODE_FAULT_PROFILES)}")
+                         f"known: {sorted(NODE_FAULT_PROFILES)}") from None
     return factory(seed, **overrides)
 
 

@@ -151,7 +151,8 @@ def profile(name: str, seed: int = 0) -> ChaosPolicy:
         factory = PROFILES[name]
     except KeyError:
         raise ValueError(
-            f"unknown chaos profile {name!r}; known: {sorted(PROFILES)}")
+            f"unknown chaos profile {name!r}; "
+            f"known: {sorted(PROFILES)}") from None
     return factory(seed)
 
 

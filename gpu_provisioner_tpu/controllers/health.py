@@ -44,13 +44,12 @@ import logging
 import math
 from collections import defaultdict, deque
 from dataclasses import dataclass
-from datetime import datetime, timezone
 from typing import Optional
 
 from ..apis import labels as wk
 from ..apis.core import Node
 from ..apis.karpenter import NodeClaim
-from ..apis.serde import now
+from ..apis.serde import now, wall_now
 from ..providers.operations import BackoffLadder
 from ..runtime import NotFoundError, Request, Result
 from ..runtime.client import Client, patch_retry
@@ -497,8 +496,7 @@ class NodeHealthController:
             return None
         if observe:
             self._observed_since.pop((name, "hb"), None)
-        age = (datetime.now(timezone.utc) - cond.last_heartbeat_time
-               ).total_seconds()
+        age = (wall_now() - cond.last_heartbeat_time).total_seconds()
         if age > bound + _TRUNCATION_SLACK:
             return (f"kubelet heartbeat is {age:.1f}s old "
                     f"(bound {bound:.0f}s); Ready is stale")

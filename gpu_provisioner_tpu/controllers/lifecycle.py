@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -39,6 +38,7 @@ from ..errors import (
     CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
     NodeClassNotReadyError,
 )
+from ..providers.operations import loop_now
 from ..runtime import NotFoundError, Request, Result
 from ..runtime.client import Client, ConflictError, patch_retry
 from ..runtime.events import Recorder
@@ -73,7 +73,7 @@ class LifecycleOptions:
 @dataclass
 class _CacheEntry:
     created: NodeClaim
-    at: float = field(default_factory=time.monotonic)
+    at: float = field(default_factory=loop_now)
 
 
 class NodeClaimLifecycleController:
@@ -431,7 +431,7 @@ class NodeClaimLifecycleController:
         nc.metadata.annotations[wk.TERMINATION_TIMESTAMP_ANNOTATION] = fmt_time(deadline)
 
     def _gc_cache(self) -> None:
-        cutoff = time.monotonic() - self.opts.launch_cache_ttl
+        cutoff = loop_now() - self.opts.launch_cache_ttl
         self._launched = {k: v for k, v in self._launched.items() if v.at > cutoff}
 
 

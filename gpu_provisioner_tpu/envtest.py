@@ -123,6 +123,20 @@ class EnvtestOptions:
     # Startup resync/orphan-adoption cadence (controllers/recovery.py);
     # the boot pass always fires immediately.
     recovery_interval: float = 600.0
+    # Runtime detectors (analysis/detectors.py), ON by default — every
+    # envtest-driven test runs under them:
+    # - stall_budget: the event-loop stall detector fails the Env at
+    #   teardown if anything held the loop longer than this (sync I/O,
+    #   time.sleep, pathological CPU sections — the BENCH r04/r05 scaling
+    #   ceiling made mechanical). 0 disables.
+    # - leak_check: at teardown, enumerate every component's background
+    #   -task seam (manager workers/pumps, workqueue timers, eviction
+    #   queue + timers, tracker poller + notify tasks, informers, fault
+    #   injector) and raise if any survived — the PR 4 tracker-only gate
+    #   generalized. Also catches non-daemon threads started mid-Env.
+    stall_budget: float = 1.0
+    stall_interval: float = 0.05
+    leak_check: bool = True
 
 
 def _make_cloud(opts: EnvtestOptions, client: InMemoryClient) -> FakeCloud:
@@ -230,38 +244,122 @@ class Env:
             crashes=self.opts.crashes, fence=fence,
             tracker=self.tracker)
         self.manager = Manager(self.client).register(*controllers)
+        # runtime detectors (analysis/detectors.py), armed in __aenter__
+        self.stall = None
+        self._threads_before: set = set()
 
     async def __aenter__(self) -> "Env":
-        if self.informers is not None:
-            await self.informers.start()   # sync before the first reconcile
-        if self.tracker is not None:
-            self.tracker.start()
-        if self.opts.node_faults is not None:
-            # raw client: the injector is the world (kubelets/hardware), not
-            # part of the operator — kube chaos must not gate its writes
-            self.opts.node_faults.start(self.client)
-        self.eviction.start()
-        await self.manager.start()
+        import os
+        from .analysis.detectors import StallDetector, thread_snapshot
+        self._threads_before = thread_snapshot()
+        self.stall = None
+        # Operability escape hatch for contended CI machines: the sentinel
+        # measures wall-clock oversleep, so whole-process CPU starvation
+        # (a parallel build, a noisy neighbor) is indistinguishable from
+        # loop-blocking code. PROVLINT_STALL_BUDGET relaxes (or, at 0,
+        # disables) every Env's budget without code changes.
+        budget = self.opts.stall_budget
+        env_budget = os.environ.get("PROVLINT_STALL_BUDGET")
+        if env_budget is not None and budget > 0:
+            relaxed = float(env_budget)
+            budget = 0.0 if relaxed <= 0 else max(budget, relaxed)
+        if budget > 0:
+            self.stall = StallDetector(budget=budget,
+                                       interval=self.opts.stall_interval)
+            self.stall.start()
+        try:
+            if self.informers is not None:
+                await self.informers.start()  # sync before first reconcile
+            if self.tracker is not None:
+                self.tracker.start()
+            if self.opts.node_faults is not None:
+                # raw client: the injector is the world (kubelets/
+                # hardware), not part of the operator — kube chaos must
+                # not gate its writes
+                self.opts.node_faults.start(self.client)
+            self.eviction.start()
+            await self.manager.start()
+        except BaseException:
+            # a failed startup never reaches __aexit__ — unwind whatever
+            # DID start (every stop is a no-op for a never-started
+            # component) or the half-born Env leaks its tasks into every
+            # later test in the process: the leak gate's own bug class
+            for closer in (self.manager.stop, self.eviction.stop,
+                           *((self.opts.node_faults.stop,)
+                             if self.opts.node_faults is not None else ()),
+                           *((self.tracker.stop,)
+                             if self.tracker is not None else ()),
+                           *((self.informers.stop,)
+                             if self.informers is not None else ())):
+                try:
+                    await closer()
+                except Exception:  # noqa: BLE001 — don't mask the cause
+                    pass
+            if self.stall is not None:
+                await self.stall.stop()
+            raise
         return self
 
     async def __aexit__(self, *exc) -> None:
-        await self.manager.stop()
-        await self.eviction.stop()
-        if self.opts.node_faults is not None:
-            await self.opts.node_faults.stop()
+        from .analysis import detectors
+        # Exception-safe teardown: one failing stop must not strand the
+        # components after it (the half-torn-down Env would leak its tasks
+        # into every later test — the same bug class the startup unwind in
+        # __aenter__ guards). Run every stop; re-raise the FIRST failure.
+        stop_error: Optional[BaseException] = None
+        for closer in (self.manager.stop, self.eviction.stop,
+                       *((self.opts.node_faults.stop,)
+                         if self.opts.node_faults is not None else ()),
+                       *((self.tracker.stop,)
+                         if self.tracker is not None else ()),
+                       *((self.informers.stop,)
+                         if self.informers is not None else ()),
+                       *((self.stall.stop,)
+                         if self.stall is not None else ())):
+            try:
+                await closer()
+            # provlint: disable=cancellation-swallow — not swallowed:
+            # the first failure (incl. a CancelledError delivered to the
+            # exiting test) is re-raised right below, AFTER the remaining
+            # components have stopped
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                stop_error = stop_error or e
+        if stop_error is not None:
+            raise stop_error
+        # Runtime detector gates, suppressed when the body is already
+        # raising so they never mask a test failure. Scoped to THIS Env's
+        # own components (a RestartableEnv zombie's rival legitimately
+        # keeps its own tracker alive) — the PR 4 tracker-only "poller
+        # outlived its Env" check generalized to every background task.
+        if exc and exc[0] is not None:
+            return
+        if self.opts.leak_check:
+            detectors.check_no_leaked_tasks(self._component_tasks())
+            detectors.check_no_leaked_threads(self._threads_before)
+        if self.stall is not None:
+            self.stall.check()
+
+    def _component_tasks(self):
+        """Every (component, task) seam this Env's operator half owns —
+        the leak gate's enumeration. New components that spawn background
+        tasks must be added here (docs/STATIC_ANALYSIS.md)."""
+        named: list[tuple[str, object]] = []
+        named += [("manager", t) for t in self.manager._tasks]
+        for c in self.manager.controllers:
+            named.append((f"workqueue-timer/{c.name}", c.queue._timer))
+        named.append(("eviction-queue", self.eviction._task))
+        named += [("eviction-timer", t) for t in self.eviction._timers]
         if self.tracker is not None:
-            await self.tracker.stop()
+            named.append(("operation-tracker poller", self.tracker._task))
+            named += [("operation-tracker notify", t)
+                      for t in self.tracker._notify_tasks]
         if self.informers is not None:
-            await self.informers.stop()
-        # Task-leak gate: THIS Env's poller must never outlive the Env — a
-        # leaked tracker task would keep polling a dead incarnation's cloud
-        # seam forever. Scoped to self.tracker (a RestartableEnv zombie's
-        # rival legitimately keeps its own tracker alive). Suppressed when
-        # the body is already raising, so it never masks a test failure.
-        if (self.tracker is not None and self.tracker.task_alive()
-                and not (exc and exc[0] is not None)):
-            raise RuntimeError(
-                "operation-tracker poller task outlived its Env")
+            named += [(f"informer/{cls.KIND}", inf._task)
+                      for cls, inf in self.informers._informers.items()]
+        if self.opts.node_faults is not None:
+            named.append(("node-fault-injector",
+                          getattr(self.opts.node_faults, "_task", None)))
+        return named
 
     def informer_cache_sizes(self) -> dict[str, int]:
         """Cached object count per kind (empty when informers are off) —

@@ -16,7 +16,7 @@ import traceback
 import platform
 
 from aiohttp import web
-from prometheus_client import Gauge, REGISTRY, generate_latest, CONTENT_TYPE_LATEST
+from prometheus_client import Gauge, generate_latest, CONTENT_TYPE_LATEST
 
 from .. import __version__
 
@@ -25,24 +25,20 @@ from ..apis.meta import _KINDS
 # imported for its side effect: registers the karpenter_cloudprovider_*
 # metric families so /metrics always exposes them, whatever the import order
 from ..cloudprovider import metrics as _cloudprovider_metrics  # noqa: F401
-from ..controllers.metrics import update_runtime_gauges
+from ..controllers.metrics import _get_or_create, update_runtime_gauges
 from ..runtime.controller import Manager
 
 
 # Build-info gauge (operator.go:69-92's karpenter_build_info analog):
 # constant 1, stamped with version identifiers for dashboards/alerts.
-def _build_info() -> Gauge:
-    name = "tpu_provisioner_build_info"
-    if name in REGISTRY._names_to_collectors:  # test re-imports
-        return REGISTRY._names_to_collectors[name]
-    g = Gauge(name, "Build/runtime identifiers (constant 1).",
-              ["version", "python_version"])
-    g.labels(version=__version__,
-             python_version=platform.python_version()).set(1)
-    return g
-
-
-BUILD_INFO = _build_info()
+# Registered at module scope through the shared get-or-create idiom
+# (controllers/metrics.py) like every other collector.
+BUILD_INFO = _get_or_create(
+    Gauge, "tpu_provisioner_build_info",
+    "Build/runtime identifiers (constant 1).",
+    ["version", "python_version"])
+BUILD_INFO.labels(version=__version__,
+                  python_version=platform.python_version()).set(1)
 
 
 def build_apps(manager: Manager, enable_profiling: bool = False):

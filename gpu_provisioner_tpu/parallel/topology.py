@@ -135,9 +135,11 @@ class SliceTopology:
         except KeyError as e:
             raise TopologyError(
                 f"node labels missing {e.args[0]!r} — was this node "
-                f"provisioned by tpu-provisioner? (have: {sorted(labels)})")
+                f"provisioned by tpu-provisioner? "
+                f"(have: {sorted(labels)})") from e
         except ValueError as e:
-            raise TopologyError(f"non-integer topology label/env value: {e}")
+            raise TopologyError(
+                f"non-integer topology label/env value: {e}") from e
         hostnames = tuple(h for h in env.get(ENV_WORKER_HOSTNAMES, "").split(",") if h)
         return cls(generation=generation, topology=topology, chips=chips,
                    hosts=hosts, worker_index=worker,

@@ -101,13 +101,19 @@ def drain_operation_waits() -> list[tuple[str, float]]:
     return out
 
 
-def _now() -> float:
-    # the loop clock inside async contexts (what every sleep is measured
-    # against); monotonic outside one (sync unit tests of the ladder)
+def loop_now() -> float:
+    """The monotonic clock seam: the loop clock inside async contexts (what
+    every sleep is measured against); ``time.monotonic`` outside one (sync
+    unit tests of the ladder). Controllers use THIS — never naked
+    ``time.monotonic()`` — so timing stays on the clock envtest's sleeps
+    run against (provlint PL004)."""
     try:
         return asyncio.get_running_loop().time()
     except RuntimeError:
         return time.monotonic()
+
+
+_now = loop_now  # internal shorthand, predates the public seam
 
 
 # ------------------------------------------------------------ backoff ladder
@@ -218,6 +224,12 @@ class OperationTracker:
         self._ops: dict[str, TrackedOperation] = {}
         self._subs: list[Callable[[TrackedOperation], Awaitable[None]]] = []
         self._task: Optional[asyncio.Task] = None
+        # In-flight subscriber notifications: fire-and-forget from the poll
+        # loop's perspective, but RETAINED so stop() can reap them — an
+        # unretained notify task outliving its tracker kept injecting into
+        # a dead incarnation's workqueue (provlint PL007; the PR 4 tracker
+        # bug class applied to the tracker's own callbacks).
+        self._notify_tasks: set[asyncio.Task] = set()
         self._wake = asyncio.Event()
         self._stopping = False
         # observability (tests, /metrics sampling)
@@ -249,6 +261,13 @@ class OperationTracker:
                 await task
             except asyncio.CancelledError:
                 pass
+        # reap in-flight subscriber notifications: completion wakes belong
+        # to THIS incarnation's workqueues, which are being torn down too
+        for t in list(self._notify_tasks):
+            t.cancel()
+        if self._notify_tasks:
+            await asyncio.gather(*self._notify_tasks, return_exceptions=True)
+        self._notify_tasks.clear()
 
     def task_alive(self) -> bool:
         return self._task is not None and not self._task.done()
@@ -476,9 +495,12 @@ class OperationTracker:
         if not notify:
             return
         for cb in list(self._subs):
-            # fire-and-forget: a slow/broken subscriber must not stall the
-            # poll loop (the callback just injects a workqueue item)
-            asyncio.ensure_future(self._notify(cb, op))
+            # a slow/broken subscriber must not stall the poll loop (the
+            # callback just injects a workqueue item) — but the task is
+            # tracked so stop() reaps it rather than leaking it
+            t = asyncio.ensure_future(self._notify(cb, op))
+            self._notify_tasks.add(t)
+            t.add_done_callback(self._notify_tasks.discard)
 
     @staticmethod
     async def _notify(cb, op: TrackedOperation) -> None:

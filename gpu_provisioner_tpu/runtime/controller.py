@@ -299,6 +299,12 @@ class Manager:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        # Workqueue teardown AFTER the workers: each queue's delayed-heap
+        # timer task must not outlive its controller (an item parked in
+        # rate-limit backoff — up to max_delay=1000s — kept the timer
+        # sleeping long after every worker was gone).
+        for c in self.controllers:
+            await c.queue.shutdown()
 
     async def run_forever(self) -> None:
         await self.start()

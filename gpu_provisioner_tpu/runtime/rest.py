@@ -407,7 +407,10 @@ class RestWatch:
                     rv = await self._list_into_queue()
                 rv = await self._stream(rv)
             except asyncio.CancelledError:
-                return
+                # close() cancelled the pump — propagate so the task ends
+                # cancelled (a swallowed cancellation here would let a
+                # mid-shutdown awaiter hang; PL002)
+                raise
             except Exception as e:
                 log.warning("watch %s broken: %s; re-listing",
                             self.cls.KIND, e)

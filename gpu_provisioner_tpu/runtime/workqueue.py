@@ -182,6 +182,18 @@ class RateLimitingQueue:
             self._shutdown = True
             self._timer_wake.set()
             self._cond.notify_all()
+            timer, self._timer = self._timer, None
+        # Reap the delayed-heap timer task OUTSIDE the lock (its loop
+        # re-acquires the condition). Without this, a queue stopped with
+        # items still parked in backoff — max_delay is 1000s — left the
+        # timer task sleeping long past its controller's teardown (found
+        # by the envtest task-leak gate; provlint PL007 bug class).
+        if timer is not None:
+            timer.cancel()
+            try:
+                await timer
+            except asyncio.CancelledError:
+                pass
 
     def __len__(self) -> int:
         return len(self._queue)

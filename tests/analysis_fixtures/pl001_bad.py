@@ -1,0 +1,16 @@
+"""PL001 true positives: blocking calls inside async defs."""
+import time
+import urllib.request
+
+
+async def reconcile():
+    time.sleep(1)                                  # BAD: blocks the loop
+
+
+async def fetch():
+    return urllib.request.urlopen("http://x")      # BAD: sync HTTP
+
+
+async def read_config():
+    with open("/etc/config") as f:                 # BAD: sync file I/O
+        return f.read()

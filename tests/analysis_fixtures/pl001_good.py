@@ -1,0 +1,17 @@
+"""PL001 true negatives: async seams, and blocking calls in sync defs."""
+import asyncio
+import time
+
+
+async def reconcile():
+    await asyncio.sleep(1)
+
+
+async def read_config():
+    return await asyncio.to_thread(_read)
+
+
+def _read():
+    time.sleep(0.01)        # sync helper: out of PL001's async-body scope
+    with open("/etc/config") as f:
+        return f.read()

@@ -1,0 +1,23 @@
+"""PL002 true positives: swallowed cancellation / crash injection."""
+import asyncio
+
+
+async def swallow_cancel():
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:      # BAD: eats the shutdown signal
+        return None
+
+
+def swallow_everything():
+    try:
+        return 1
+    except BaseException:               # BAD: eats SimulatedCrash too
+        return None
+
+
+def swallow_crash(chaos):
+    try:
+        chaos.hit("point")
+    except (ValueError, SystemExit):    # BAD: SystemExit never re-raised
+        pass

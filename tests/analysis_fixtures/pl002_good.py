@@ -1,0 +1,26 @@
+"""PL002 true negatives: re-raise, the task-reap idiom, narrow excepts."""
+import asyncio
+
+
+async def isolate_and_reraise():
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        raise                               # propagates
+    except Exception:                       # cannot catch CancelledError
+        return None
+
+
+async def reap_cancelled_task(task):
+    task.cancel()
+    try:
+        await task                          # the TASK's own cancellation
+    except asyncio.CancelledError:
+        pass
+
+
+def reraise_base():
+    try:
+        return 1
+    except BaseException:
+        raise

@@ -1,0 +1,10 @@
+"""PL003 true positives: cloud mutations with no preceding fence check."""
+
+
+class Provider:
+    async def create(self, pool):
+        return await self.nodepools.begin_create(pool)      # BAD: unfenced
+
+    async def delete(self, name):
+        await self.queued.delete(name)                      # BAD: unfenced
+        return await self.nodepools.begin_delete(name)      # BAD: unfenced

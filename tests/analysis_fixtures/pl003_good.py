@@ -1,0 +1,19 @@
+"""PL003 true negatives: fence check precedes every cloud mutation."""
+
+
+class Provider:
+    def _fence_check(self):
+        if self.fence is not None:
+            self.fence.check()
+
+    async def create(self, pool):
+        self._fence_check()
+        return await self.nodepools.begin_create(pool)
+
+    async def delete(self, name):
+        self.fence.check()
+        await self.queued.delete(name)
+        return await self.nodepools.begin_delete(name)
+
+    async def read_only(self, name):
+        return await self.nodepools.get(name)   # reads need no fence

@@ -1,0 +1,15 @@
+"""PL004 true positives: naked wall clocks in a controller."""
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+
+
+async def reconcile():
+    started = time.monotonic()                  # BAD
+    stamp = datetime.now(timezone.utc)          # BAD
+    return started, stamp, time.time()          # BAD
+
+
+@dataclass
+class Entry:
+    at: float = field(default_factory=time.monotonic)   # BAD: bare reference

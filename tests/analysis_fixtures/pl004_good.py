@@ -1,0 +1,8 @@
+"""PL004 true negatives: the injected clock seams."""
+import asyncio
+
+
+async def reconcile(serde_now, loop_now):
+    mono = asyncio.get_event_loop().time()
+    wall = serde_now()
+    return mono, wall, loop_now()

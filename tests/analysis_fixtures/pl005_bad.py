@@ -1,0 +1,10 @@
+"""PL005 true positives: metric registration inside functions."""
+from prometheus_client import Counter, Gauge
+
+
+def register_counter():
+    return Counter("x_total", "doc", ["label"])     # BAD
+
+
+async def reconcile():
+    Gauge("depth", "doc", []).set(1)                # BAD: per-reconcile

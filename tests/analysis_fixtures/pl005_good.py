@@ -1,0 +1,8 @@
+"""PL005 true negatives: module-scope registration; mutation in functions."""
+from prometheus_client import Counter
+
+REQUESTS = Counter("x_total", "doc", ["label"])
+
+
+async def reconcile():
+    REQUESTS.labels("a").inc()      # mutating an existing collector is fine

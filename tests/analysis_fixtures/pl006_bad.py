@@ -1,0 +1,10 @@
+"""PL006 true positive: await while holding a non-async lock."""
+import asyncio
+import threading
+
+_lock = threading.Lock()
+
+
+async def critical():
+    with _lock:                     # sync lock …
+        await asyncio.sleep(0.1)    # BAD: … held across a suspension point

@@ -1,0 +1,16 @@
+"""PL006 true negatives: async lock, or sync lock with no await inside."""
+import asyncio
+import threading
+
+_alock = asyncio.Lock()
+_slock = threading.Lock()
+
+
+async def critical():
+    async with _alock:
+        await asyncio.sleep(0.1)
+
+
+def sync_critical(shared):
+    with _slock:
+        shared.append(1)

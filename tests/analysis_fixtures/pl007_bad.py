@@ -1,0 +1,11 @@
+"""PL007 true positives: fire-and-forget background tasks."""
+import asyncio
+
+
+async def fire_and_forget(work):
+    asyncio.ensure_future(work())           # BAD: handle discarded
+
+
+async def assign_and_drop(work):
+    t = asyncio.create_task(work())         # BAD: never referenced again
+    return None

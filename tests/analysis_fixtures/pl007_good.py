@@ -1,0 +1,18 @@
+"""PL007 true negatives: retained / tracked / reaped task handles."""
+import asyncio
+
+
+class Component:
+    def start(self, work):
+        self._task = asyncio.create_task(work())    # retained on self
+
+
+async def tracked(work, registry: set):
+    t = asyncio.create_task(work())
+    registry.add(t)
+    t.add_done_callback(registry.discard)
+
+
+async def awaited(work):
+    t = asyncio.ensure_future(work())
+    return await t

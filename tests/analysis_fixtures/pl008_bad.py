@@ -1,0 +1,9 @@
+"""PL008 true positives: mutable default arguments."""
+
+
+def build(labels={}, taints=[]):            # BAD ×2
+    return labels, taints
+
+
+async def reconcile(*, seen=set(), extra=dict()):   # BAD ×2
+    return seen, extra

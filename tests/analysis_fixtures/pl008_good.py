@@ -1,0 +1,9 @@
+"""PL008 true negatives: None defaults materialized inside."""
+
+
+def build(labels=None, taints=None):
+    return dict(labels or {}), list(taints or [])
+
+
+async def reconcile(*, seen=None, retries=3, name=""):
+    return seen if seen is not None else set(), retries, name

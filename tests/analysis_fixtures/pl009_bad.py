@@ -1,0 +1,8 @@
+"""PL009 true positives: ungated crash seams in control-plane layers."""
+from ..chaos.crash import SimulatedCrash            # BAD in this layer
+
+
+class Provider:
+    async def create(self, pool):
+        self.crashes.hit("after_begin_create", pool.name)   # BAD: no gate
+        return pool

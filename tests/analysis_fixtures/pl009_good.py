@@ -1,0 +1,14 @@
+"""PL009 true negative: the None-gated _crash helper idiom."""
+
+
+class Provider:
+    def __init__(self, crashes=None):
+        self.crashes = crashes      # chaos.CrashPoints; None in production
+
+    def _crash(self, point, key):
+        if self.crashes is not None:
+            self.crashes.hit(point, key)
+
+    async def create(self, pool):
+        self._crash("after_begin_create", pool.name)
+        return pool

@@ -1,0 +1,9 @@
+"""PL010 true positive: deadline-free sleep polling in a test."""
+import asyncio
+
+
+async def test_converges(env):
+    while True:                         # BAD: no deadline anywhere
+        if env.done:
+            break
+        await asyncio.sleep(0.01)

@@ -1,0 +1,18 @@
+"""PL010 true negatives: deadline-bounded polls."""
+import asyncio
+
+
+async def test_converges(env):
+    deadline = asyncio.get_event_loop().time() + 10.0
+    while True:
+        if env.done:
+            break
+        assert asyncio.get_event_loop().time() < deadline, "never converged"
+        await asyncio.sleep(0.01)
+
+
+async def test_bounded_laps(env):
+    for _ in range(100):
+        await asyncio.sleep(0.01)
+        if env.done:
+            break

@@ -1,0 +1,7 @@
+"""PL011 true positive: marker not registered in pyproject.toml."""
+import pytest
+
+
+@pytest.mark.totally_unregistered_marker        # BAD
+def test_something():
+    assert True

@@ -1,0 +1,13 @@
+"""PL011 true negatives: registered and builtin markers."""
+import pytest
+
+
+@pytest.mark.chaos                      # registered in pyproject.toml
+@pytest.mark.parametrize("x", [1, 2])   # pytest builtin
+def test_something(x):
+    assert x
+
+
+@pytest.mark.skipif(True, reason="builtin")
+def test_skipped():
+    assert True

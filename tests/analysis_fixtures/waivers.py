@@ -1,0 +1,20 @@
+"""Waiver-syntax corpus: valid waivers suppress, malformed ones are
+themselves findings (PL000)."""
+
+
+def trailing_waiver(items=[]):  # provlint: disable=mutable-default — fixture: shared sentinel is intended here
+    return items
+
+
+# provlint: disable=mutable-default — fixture: comment-only waiver covers
+# the next code line, wrapped reason and all
+def comment_waiver(items=[]):
+    return items
+
+
+def missing_reason(items=[]):  # provlint: disable=mutable-default
+    return items
+
+
+def unknown_rule(items=[]):  # provlint: disable=no-such-rule — some reason
+    return items

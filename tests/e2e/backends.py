@@ -82,7 +82,8 @@ class FakeKubeAPIServer:
             cls = _cls_for(req.match_info["plural"])
         except KeyError:
             raise web.HTTPNotFound(text=f"unknown resource "
-                                        f"{req.match_info['plural']!r}")
+                                        f"{req.match_info['plural']!r}"
+                                   ) from None
         return (cls, req.match_info.get("ns", ""),
                 req.match_info.get("name", ""))
 
@@ -179,6 +180,9 @@ class FakeKubeAPIServer:
                 line = json.dumps({"type": ev.type,
                                    "object": ev.object.to_dict()}) + "\n"
                 await resp.write(line.encode())
+        # provlint: disable=cancellation-swallow — peer disconnect mid-write
+        # is this streaming handler's normal exit; aiohttp owns the handler
+        # task and reaps it — finishing the response beats re-raising here
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:

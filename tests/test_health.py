@@ -82,6 +82,8 @@ def start_replacer(env: Env, specs):
     counts = defaultdict(int)
 
     async def run():
+        # provlint: disable=unbounded-sleep-poll — not a poll-until: this
+        # simulator runs until the test cancels the returned task
         while True:
             for name, shape, group in specs:
                 try:

@@ -1,0 +1,200 @@
+"""provlint: rule-catalog tests over the fixture corpus, waiver semantics,
+and the enforcement test that keeps the real tree clean.
+
+Each rule gets ≥1 true-positive and ≥1 true-negative snippet under
+tests/analysis_fixtures/ (excluded from normal lint walks). Roles are forced
+per fixture so a controllers-scoped rule can be exercised against a snippet
+that lives in the test tree.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from gpu_provisioner_tpu.analysis import RULES, lint_file, lint_paths
+from gpu_provisioner_tpu.analysis.provlint import (
+    ROLE_CONTROLLERS, ROLE_PACKAGE, ROLE_PROVIDERS, ROLE_RUNTIME, ROLE_TESTS,
+    infer_roles, main,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+CONTROL_PLANE = frozenset({ROLE_PACKAGE, ROLE_CONTROLLERS, ROLE_PROVIDERS,
+                           ROLE_RUNTIME})
+
+
+def rules_fired(path: Path, roles) -> set[str]:
+    return {f.rule for f in lint_file(path, roles=frozenset(roles))}
+
+
+# One (rule, fixture-pair, forced-roles, expected-finding-count) row per rule.
+CASES = [
+    ("PL001", "pl001", {ROLE_PACKAGE, ROLE_RUNTIME}, 3),
+    ("PL002", "pl002", {ROLE_PACKAGE}, 3),
+    ("PL003", "pl003", {ROLE_PACKAGE, ROLE_PROVIDERS}, 3),
+    ("PL004", "pl004", {ROLE_PACKAGE, ROLE_CONTROLLERS}, 4),
+    ("PL005", "pl005", {ROLE_PACKAGE}, 2),
+    ("PL006", "pl006", {ROLE_PACKAGE}, 1),
+    ("PL007", "pl007", {ROLE_PACKAGE}, 2),
+    ("PL008", "pl008", {ROLE_PACKAGE, ROLE_CONTROLLERS}, 4),
+    ("PL009", "pl009", {ROLE_PACKAGE, ROLE_PROVIDERS}, 2),
+    ("PL010", "pl010", {ROLE_TESTS}, 1),
+    ("PL011", "pl011", {ROLE_TESTS}, 1),
+]
+
+
+@pytest.mark.parametrize("rule_id,stem,roles,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_bad_fixture(rule_id, stem, roles, expected):
+    findings = [f for f in lint_file(FIXTURES / f"{stem}_bad.py",
+                                     roles=frozenset(roles))
+                if f.rule == rule_id]
+    assert len(findings) == expected, (
+        f"{rule_id} expected {expected} finding(s), got: {findings}")
+
+
+@pytest.mark.parametrize("rule_id,stem,roles,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_abstains_on_good_fixture(rule_id, stem, roles, expected):
+    findings = [f for f in lint_file(FIXTURES / f"{stem}_good.py",
+                                     roles=frozenset(roles))
+                if f.rule == rule_id]
+    assert findings == [], f"{rule_id} false positives: {findings}"
+
+
+def test_controller_calling_mutation_is_flagged_even_with_fence():
+    # PL003's controller arm: controllers never call cloud mutations at
+    # all — a fence in the same function doesn't excuse the layering.
+    findings = [f for f in lint_file(
+        FIXTURES / "pl003_good.py",
+        roles=frozenset({ROLE_PACKAGE, ROLE_CONTROLLERS}))
+        if f.rule == "PL003"]
+    assert len(findings) == 3
+    assert all("provider seam" in f.message for f in findings)
+
+
+# ------------------------------------------------------------------ waivers
+
+def test_waiver_semantics():
+    findings = lint_file(FIXTURES / "waivers.py",
+                         roles=frozenset({ROLE_PACKAGE, ROLE_CONTROLLERS}))
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # trailing and comment-only waivers suppressed their violations …
+    waived_lines = {5, 11}
+    assert not any(f.line in waived_lines for f in by_rule.get("PL008", []))
+    # … the two unwaived violations remain …
+    assert len(by_rule.get("PL008", [])) == 2
+    # … and the malformed waivers (no reason / unknown rule) are findings.
+    pl000 = by_rule.get("PL000", [])
+    assert len(pl000) == 2
+    assert any("mandatory" in f.message for f in pl000)
+    assert any("unknown rule" in f.message for f in pl000)
+
+
+# ------------------------------------------------------------- engine bits
+
+def test_role_inference():
+    assert ROLE_CONTROLLERS in infer_roles(
+        REPO / "gpu_provisioner_tpu" / "controllers" / "health.py")
+    assert ROLE_PACKAGE in infer_roles(
+        REPO / "gpu_provisioner_tpu" / "envtest.py")
+    assert infer_roles(REPO / "tests" / "test_provlint.py") == frozenset(
+        {ROLE_TESTS})
+
+
+def test_role_inference_survives_repo_dir_named_like_the_package():
+    """Review-pass regression: a checkout directory named like the package
+    must not shadow the package dir — first-occurrence matching silently
+    dropped the controllers role (and with it PL001/PL003/PL004/PL008)."""
+    path = Path("/home/u/gpu_provisioner_tpu/gpu_provisioner_tpu/"
+                "controllers/health.py")
+    assert ROLE_CONTROLLERS in infer_roles(path)
+
+
+def test_select_subset_keeps_foreign_waivers_valid():
+    """Review-pass regression: --select derived waiver validity from the
+    filtered rule set, so a pristine tree exited 1 with PL000 noise for
+    every waiver naming an unselected rule."""
+    assert main(["--select", "PL001",
+                 str(REPO / "gpu_provisioner_tpu"),
+                 str(REPO / "tests")]) == 0
+
+
+def test_pl004_catches_from_imported_clock(tmp_path):
+    """Review-pass regression: `from time import monotonic` evaded PL004 —
+    the import style must not be the bypass."""
+    f = tmp_path / "ctrl.py"
+    f.write_text("from time import monotonic\ncutoff = monotonic()\n")
+    findings = lint_file(f, roles=frozenset({ROLE_PACKAGE,
+                                             ROLE_CONTROLLERS}))
+    assert [x.rule for x in findings] == ["PL004"]
+
+
+def test_waiver_syntax_inside_string_literal_is_inert(tmp_path):
+    """Review-pass regression: waiver-looking text in a docstring/string
+    must neither waive the next line nor count as malformed."""
+    f = tmp_path / "doc.py"
+    f.write_text(
+        'import time\n'
+        'DOC = """example: # provlint: disable=naked-wall-clock — x"""\n'
+        'a = time.monotonic()\n'
+        'BAD = "# provlint: disable=nonsense"\n')
+    findings = lint_file(f, roles=frozenset({ROLE_PACKAGE,
+                                             ROLE_CONTROLLERS}))
+    assert [(x.rule, x.line) for x in findings] == [("PL004", 3)]
+
+
+def test_comment_waiver_does_not_bleed_past_its_target_line(tmp_path):
+    """Review-pass regression: a comment-only waiver covered the line
+    AFTER its target code line too, silently hiding a second violation."""
+    f = tmp_path / "two_clocks.py"
+    f.write_text(
+        "import time\n"
+        "# provlint: disable=naked-wall-clock — first one is measured\n"
+        "a = time.monotonic()\n"
+        "b = time.monotonic()\n")
+    findings = lint_file(f, roles=frozenset({ROLE_PACKAGE,
+                                             ROLE_CONTROLLERS}))
+    assert [(x.rule, x.line) for x in findings] == [("PL004", 4)]
+
+
+def test_catalog_has_at_least_ten_rules():
+    assert len(RULES) >= 10
+    assert len({r.id for r in RULES}) == len(RULES)
+    assert len({r.name for r in RULES}) == len(RULES)
+
+
+def test_cli(tmp_path, capsys):
+    assert main(["--list-rules"]) == 0
+    bad = tmp_path / "gpu_provisioner_tpu" / "controllers" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\ncutoff = time.monotonic()\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "PL004" in out and "naked-wall-clock" in out
+    assert main([str(tmp_path / "nope")]) == 2
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def broken(:\n")
+    findings = lint_file(f, roles=frozenset({ROLE_PACKAGE}))
+    assert findings and findings[0].rule == "PL000"
+
+
+# -------------------------------------------------------------- enforcement
+
+def test_whole_tree_is_clean():
+    """The acceptance gate, run on every tier-1 pass: provlint over the
+    real package + tests must stay at zero findings (waivers carry their
+    reasons inline). A regression in any enforced invariant fails HERE."""
+    findings = lint_paths([REPO / "gpu_provisioner_tpu", REPO / "tests"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_fixture_corpus_is_excluded_from_tree_walks():
+    findings = lint_paths([FIXTURES.parent])
+    assert not any("analysis_fixtures" in f.path for f in findings)
