@@ -40,7 +40,7 @@ lint: ## Static analysis: ruff + mypy (advisory baseline when installed) + provl
 	$(PY) -m gpu_provisioner_tpu.analysis gpu_provisioner_tpu tests
 
 .PHONY: verify
-verify: lint unit-test ## Default verify path: static analysis, then the unit suites
+verify: lint unit-test trace-smoke ## Default verify path: static analysis, the unit suites, then the claimtrace smoke
 
 .PHONY: unit-test
 unit-test: ## Unit tests (reference Makefile:171-175)
@@ -76,8 +76,16 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02 cloud-call or BENCH_pr04 poll/pinned-worker budget regressions
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09 claimtrace gates
 	$(PY) -m bench.bench_provision
+
+.PHONY: trace
+trace: ## 100-claim wave under claimtrace; print the critical-path attribution summary
+	$(PY) -m bench.bench_provision --trace --claims 100
+
+.PHONY: trace-smoke
+trace-smoke: ## Small traced wave: the claimtrace attribution gate as a verify smoke
+	$(PY) -m bench.bench_provision --trace-smoke
 
 .PHONY: bench-headline
 bench-headline: ## Fleet-scale headline benchmark JSON line

@@ -24,13 +24,22 @@ against the operation tracker (non-blocking state machines, one batched
 reconcile), and the wave-wide poll-call count
 (``nodepools.get`` + ``nodepools.list`` + client-side LRO polls).
 
-Writes ``BENCH_pr02.json`` with ``--write`` and ``BENCH_pr04.json`` with
-``--write-pr04``; by default (and under ``make bench``) it re-measures and
-REFUSES to pass if cloud-call counts regress beyond the budgets recorded in
-EITHER file.
+PR 9 adds the **traced wave** (``BENCH_pr09.json``): the claim wave under
+claimtrace, its ready-wall decomposed into named phases by the critical-path
+analyzer (observability/critical_path.py), plus an untraced re-run as the
+overhead baseline. Gates: named phases explain ≥95% of the wall; tracing
+costs ≤5% wall vs disabled. ``--trace`` prints the attribution summary for
+one traced wave (``make trace``); ``--trace-smoke`` is the small-wave
+variant ``make verify`` runs.
+
+Writes ``BENCH_pr02.json`` with ``--write``, ``BENCH_pr04.json`` with
+``--write-pr04`` and ``BENCH_pr09.json`` with ``--write-pr09``; by default
+(and under ``make bench``) it re-measures and REFUSES to pass if cloud-call
+counts regress beyond the recorded budgets or the claimtrace gates fail.
 
 Usage: python -m bench.bench_provision [--claims 100] [--pools 100]
-                                       [--write] [--write-pr04] [--fast]
+                                       [--write] [--write-pr04]
+                                       [--write-pr09] [--trace] [--fast]
 """
 
 from __future__ import annotations
@@ -47,6 +56,13 @@ from pathlib import Path
 
 BENCH_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr02.json"
 BENCH_PR04_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr04.json"
+BENCH_PR09_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr09.json"
+
+# PR 9 claimtrace gates (acceptance criteria, not recorded budgets): the
+# named phases must explain ≥95% of the traced wave's ready-wall, and
+# tracing must cost ≤5% wall vs the tracer disabled.
+PR09_ATTRIBUTION_MIN = 0.95
+PR09_OVERHEAD_MAX = 0.05
 
 # Simulated apiserver round-trip for the GC-pass harness. The in-memory
 # store answers in microseconds; a serial-per-pool list path only shows its
@@ -231,6 +247,103 @@ async def bench_wave(n_claims: int, shape: str = "tpu-v5e-8") -> dict:
         "leaked_pools": leaked_pools,
         "leaked_queued_resources": leaked_qrs,
     }
+
+
+# --------------------------------------------------------------- traced wave
+
+async def bench_traced_wave(n_claims: int, tracing: bool = True,
+                            shape: str = "tpu-v5e-8") -> dict:
+    """PR 9 claimtrace: the claim wave with per-claim tracing on (or off,
+    for the overhead baseline). With tracing on, the wave's ready-wall is
+    decomposed by the critical-path analyzer over the trace store."""
+    from gpu_provisioner_tpu.controllers.lifecycle import LifecycleOptions
+    from gpu_provisioner_tpu.controllers.termination import TerminationOptions
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    opts = EnvtestOptions(
+        create_latency=0.05, node_join_delay=0.01, node_ready_delay=0.01,
+        gc_interval=1.0, leak_grace=1.0, node_wait_attempts=600,
+        lifecycle=LifecycleOptions(termination_requeue=0.5,
+                                   registration_requeue=0.5),
+        termination=TerminationOptions(requeue=0.5, instance_requeue=0.5),
+        max_concurrent_reconciles=1024, use_informer=True,
+        tracing=tracing, trace_buffer=max(2 * n_claims, 64),
+        # measurement at saturation: stall gate off, leak gate stays on
+        stall_budget=0.0)
+    async with Env(opts) as env:
+        async def provision(i: int) -> float:
+            t = time.perf_counter()
+            await env.client.create(make_nodeclaim(f"t{i:04d}", shape,
+                                                   workspace=f"ws{i}"))
+            await env.wait_ready(f"t{i:04d}", timeout=120, poll=0.1)
+            return time.perf_counter() - t
+
+        # wave start on the LOOP clock: span timestamps are loop time, so
+        # the attribution window must anchor on the same base
+        t0 = asyncio.get_event_loop().time()
+        wall0 = time.perf_counter()
+        readies = await asyncio.gather(*(provision(i)
+                                         for i in range(n_claims)))
+        ready_wall = time.perf_counter() - wall0
+
+        attribution = None
+        if tracing:
+            from gpu_provisioner_tpu.observability import wave_attribution
+            attribution = wave_attribution(env.trace_store.traces(), t0)
+    return {
+        "claims": n_claims,
+        "tracing": tracing,
+        "ready_p50_s": round(statistics.median(readies), 4),
+        "ready_p95_s": round(_pctl(readies, 0.95), 4),
+        "ready_wall_s": round(ready_wall, 3),
+        "attribution": attribution,
+    }
+
+
+async def run_pr09(n_claims: int, repeats: int = 2) -> dict:
+    """Traced vs untraced wave. The overhead comparison uses the best of
+    ``repeats`` runs per mode — min-of-N damps scheduler noise, which at a
+    5% gate on a seconds-scale wall otherwise dominates the measurement."""
+    traced_runs = [await bench_traced_wave(n_claims, tracing=True)
+                   for _ in range(repeats)]
+    untraced_runs = [await bench_traced_wave(n_claims, tracing=False)
+                     for _ in range(repeats)]
+    traced = min(traced_runs, key=lambda r: r["ready_wall_s"])
+    untraced = min(untraced_runs, key=lambda r: r["ready_wall_s"])
+    overhead = (traced["ready_wall_s"]
+                / max(untraced["ready_wall_s"], 1e-9) - 1.0)
+    return {
+        "bench": "claimtrace",
+        "pr": 9,
+        "traced": traced,
+        "untraced": {k: untraced[k] for k in
+                     ("ready_wall_s", "ready_p50_s", "ready_p95_s")},
+        "tracing_overhead_fraction": round(overhead, 4),
+        "attribution": traced["attribution"],
+        "gates": {"attributed_fraction_min": PR09_ATTRIBUTION_MIN,
+                  "overhead_max": PR09_OVERHEAD_MAX},
+    }
+
+
+def check_pr09(results: dict) -> list[str]:
+    out: list[str] = []
+    attribution = results.get("attribution")
+    if attribution is None:
+        return ["traced wave produced no attribution (no claim reached "
+                "ready with a trace)"]
+    frac = attribution["attributed_fraction"]
+    if frac < PR09_ATTRIBUTION_MIN:
+        out.append(
+            f"critical-path attribution too low: {frac:.3f} < "
+            f"{PR09_ATTRIBUTION_MIN} of the ready-wall explained by named "
+            "phases (a new unnamed phase crept into the hot path?)")
+    overhead = results["tracing_overhead_fraction"]
+    if overhead > PR09_OVERHEAD_MAX:
+        out.append(
+            f"tracing overhead regressed: {100 * overhead:.1f}% > "
+            f"{100 * PR09_OVERHEAD_MAX:.0f}% wall vs disabled")
+    return out
 
 
 # ----------------------------------------------------- worker-constrained wave
@@ -456,10 +569,40 @@ def main(argv=None) -> int:
                     help="rewrite BENCH_pr02.json with fresh numbers+budget")
     ap.add_argument("--write-pr04", action="store_true",
                     help="rewrite BENCH_pr04.json with fresh numbers+budget")
+    ap.add_argument("--trace", action="store_true",
+                    help="traced wave only: print the critical-path "
+                         "attribution summary and exit")
+    ap.add_argument("--trace-smoke", action="store_true",
+                    help="small traced wave for make verify "
+                         "(attribution gate only, no overhead baseline)")
+    ap.add_argument("--no-traced", action="store_true",
+                    help="skip the PR 9 traced-wave attribution/overhead "
+                         "gates")
+    ap.add_argument("--write-pr09", action="store_true",
+                    help="rewrite BENCH_pr09.json with fresh numbers")
     args = ap.parse_args(argv)
     if args.fast:
         args.claims, args.pools = 10, 20
         args.constrained_claims = 24
+
+    if args.trace or args.trace_smoke:
+        from gpu_provisioner_tpu.observability import render_attribution
+        n = 12 if args.trace_smoke else args.claims
+        res = asyncio.run(bench_traced_wave(n, tracing=True))
+        if res["attribution"] is None:
+            print("no attribution: no traced claim reached ready",
+                  file=sys.stderr)
+            return 1
+        print(render_attribution(res["attribution"]))
+        frac = res["attribution"]["attributed_fraction"]
+        if frac < PR09_ATTRIBUTION_MIN:
+            print(f"TRACE GATE: attributed fraction {frac:.3f} < "
+                  f"{PR09_ATTRIBUTION_MIN}", file=sys.stderr)
+            return 1
+        print(f"attribution OK: {100 * frac:.1f}% of the "
+              f"{res['ready_wall_s']}s ready-wall named "
+              f"({n} claims)", file=sys.stderr)
+        return 0
 
     results = asyncio.run(run(args.claims, args.pools,
                               with_wave=not args.no_wave))
@@ -501,6 +644,25 @@ def main(argv=None) -> int:
         else:
             print("constrained-wave budget OK "
                   f"(recorded in {BENCH_PR04_FILE.name})", file=sys.stderr)
+
+    if args.no_traced:
+        return rc
+
+    pr09 = asyncio.run(run_pr09(args.claims))
+    print(json.dumps(pr09, indent=2))
+    violations = check_pr09(pr09)
+    for v in violations:
+        print(f"CLAIMTRACE GATE: {v}", file=sys.stderr)
+    if violations:
+        rc = 1
+    else:
+        print(f"claimtrace gates OK (attribution "
+              f"{pr09['attribution']['attributed_fraction']:.3f}, overhead "
+              f"{100 * pr09['tracing_overhead_fraction']:+.1f}%)",
+              file=sys.stderr)
+    if args.write_pr09:
+        BENCH_PR09_FILE.write_text(json.dumps(pr09, indent=2) + "\n")
+        print(f"wrote {BENCH_PR09_FILE}", file=sys.stderr)
     return rc
 
 

@@ -463,6 +463,46 @@ def check_unregistered_marker(ctx: RuleContext) -> list[tuple[int, str]]:
     return out
 
 
+# ------------------------------------------------------ PL012 unclosed-span
+
+def _is_span_call(node: ast.AST, attr: str) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr)
+
+
+def _guarantees_span_end(fn: ast.AST) -> bool:
+    """True when the function carries a ``try``/``finally`` whose finalbody
+    calls ``span_end`` — the only manual shape that closes the span on every
+    exit path (the canonical pair opens the span immediately BEFORE the
+    try, so the check is function-scoped, not try-body-scoped)."""
+    for node in body_walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if _is_span_call(sub, "span_end"):
+                    return True
+    return False
+
+
+def check_unclosed_span(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in ctx.functions():
+        if _guarantees_span_end(fn):
+            continue
+        for node in body_walk(fn):
+            if _is_span_call(node, "span_begin"):
+                out.append((node.lineno, (
+                    f"span_begin in {fn.name!r} without a finally-guaranteed "
+                    f"span_end — an exception between begin and end leaves "
+                    f"the span open and its contextvar leaks trace ids into "
+                    f"every later log line and Event on this task; use "
+                    f"tracer.span() (context manager) or close the token in "
+                    f"a try/finally")))
+    return out
+
+
 # ----------------------------------------------------------------- catalog
 
 RULES: list[Rule] = [
@@ -511,4 +551,8 @@ RULES: list[Rule] = [
     Rule("PL011", "unregistered-pytest-marker", frozenset({ROLE_TESTS}),
          "pytest markers used in tests are registered in pyproject.toml",
          check_unregistered_marker),
+    Rule("PL012", "unclosed-span", frozenset({ROLE_PACKAGE}),
+         "claimtrace span_begin is closed via tracer.span() or a "
+         "try/finally span_end — an open span leaks trace ids into every "
+         "later log line on the task (PR 9 claimtrace)", check_unclosed_span),
 ]

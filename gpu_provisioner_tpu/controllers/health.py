@@ -550,6 +550,13 @@ class NodeHealthController:
         await self._uncordon(node.metadata.name)
         self._repairs.pop(node.metadata.name, None)
         self.budget.release(node.metadata.name)
+        if self.recorder is not None:
+            nc = await nodeclaim_for_node(self.client, node)
+            if nc is not None:
+                await self.recorder.publish(
+                    nc, "Normal", "NodeRepairAborted",
+                    f"node {node.metadata.name} recovered ({rep.reason}); "
+                    "drain aborted, node uncordoned")
 
     def _forget(self, name: str) -> None:
         self._repairs.pop(name, None)

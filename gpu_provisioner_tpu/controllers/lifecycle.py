@@ -23,6 +23,7 @@ Deliberate departures, per SURVEY.md §7 step 5:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 from dataclasses import dataclass, field
 from typing import Optional
@@ -80,10 +81,14 @@ class NodeClaimLifecycleController:
     NAME = "nodeclaim.lifecycle"
 
     def __init__(self, client: Client, cloudprovider, recorder: Optional[Recorder] = None,
-                 options: Optional[LifecycleOptions] = None):
+                 options: Optional[LifecycleOptions] = None, tracer=None):
         self.client = client
         self.cp = cloudprovider
         self.recorder = recorder
+        # claimtrace tracer (duck-typed, optional): status-write spans +
+        # the launched/registered/ready annotations the critical-path
+        # analyzer keys off.
+        self.tracer = tracer
         self.opts = options or LifecycleOptions()
         # Launch idempotence cache by UID: survives duplicate reconciles when
         # the status write raced (launch.go:64-74).
@@ -92,6 +97,15 @@ class NodeClaimLifecycleController:
     async def _publish(self, obj, etype, reason, message):
         if self.recorder is not None:
             await self.recorder.publish(obj, etype, reason, message)
+
+    def _annotate(self, claim: str, event: str, **attrs) -> None:
+        if self.tracer is not None:
+            self.tracer.annotate(claim, event, **attrs)
+
+    def _span(self, claim: str, name: str, **attrs):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(claim, name, **attrs)
 
     # ------------------------------------------------------------ reconcile
     async def reconcile(self, req: Request) -> Result:
@@ -102,6 +116,12 @@ class NodeClaimLifecycleController:
             return Result()
         if not is_managed(nc):
             return Result()
+        if self.tracer is not None:
+            attrs = {"uid": nc.metadata.uid}
+            group = nc.metadata.labels.get(wk.TPU_SLICE_GROUP_LABEL)
+            if group:
+                attrs["slice_group"] = group
+            self.tracer.set_trace_attrs(nc.metadata.name, **attrs)
         if nc.metadata.deletion_timestamp is not None:
             return await self._finalize(nc)
 
@@ -170,13 +190,16 @@ class NodeClaimLifecycleController:
                     changed = True
             return None if changed else False
         try:
-            # Meta BEFORE status: conditions (incl. Ready) must never be
-            # observable while the launch-merged labels are still unwritten —
-            # a reader acting on Ready would see a claim without its topology
-            # labels, and _launch never re-merges once Launched persists.
-            await patch_retry(self.client, NodeClaim, nc.metadata.name, copy_meta)
-            await patch_retry(self.client, NodeClaim, nc.metadata.name, copy_status,
-                              status=True)
+            with self._span(nc.metadata.name, "status-write"):
+                # Meta BEFORE status: conditions (incl. Ready) must never be
+                # observable while the launch-merged labels are still
+                # unwritten — a reader acting on Ready would see a claim
+                # without its topology labels, and _launch never re-merges
+                # once Launched persists.
+                await patch_retry(self.client, NodeClaim, nc.metadata.name,
+                                  copy_meta)
+                await patch_retry(self.client, NodeClaim, nc.metadata.name,
+                                  copy_status, status=True)
         except ConflictError:
             pass  # next reconcile sees fresh state
 
@@ -236,6 +259,7 @@ class NodeClaimLifecycleController:
         if created.status.capacity:
             nc.status.capacity = created.status.capacity
         cs.set_true(LAUNCHED, "Launched")
+        self._annotate(nc.metadata.name, "launched")
         NODECLAIMS_CREATED.labels(self.cp.name()).inc()
         return Result()
 
@@ -264,6 +288,7 @@ class NodeClaimLifecycleController:
         if not nc.status.provider_id:
             nc.status.provider_id = worker0.spec.provider_id
         cs.set_true(REGISTERED, "Registered")
+        self._annotate(nc.metadata.name, "registered", hosts=hosts)
         return Result()
 
     async def _sync_node(self, nc: NodeClaim, node: Node) -> None:
@@ -329,6 +354,7 @@ class NodeClaimLifecycleController:
             return Result(requeue_after=self.opts.registration_requeue)
 
         cs.set_true(INITIALIZED, "Initialized")
+        self._annotate(nc.metadata.name, "ready")
         self._observe_provision(nc)
         return Result()
 
@@ -413,6 +439,7 @@ class NodeClaimLifecycleController:
                 return False
             obj.metadata.finalizers.remove(wk.TERMINATION_FINALIZER)
         await patch_retry(self.client, NodeClaim, nc.metadata.name, drop_finalizer)
+        self._annotate(nc.metadata.name, "terminated")
         NODECLAIMS_TERMINATED.labels(self.cp.name()).inc()
         if nc.metadata.deletion_timestamp is not None:
             TERMINATION_DURATION.labels(self.cp.name()).observe(

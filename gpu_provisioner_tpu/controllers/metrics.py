@@ -57,6 +57,31 @@ RECONCILE_RETRIES_EXHAUSTED = _get_or_create(
     "Items that hit the per-item retry bound and degraded to slow retry.",
     ["controller"])
 
+RECONCILE_DURATION = _get_or_create(
+    Histogram, "tpu_provisioner_reconcile_duration_seconds",
+    "Per-reconcile wall time by controller (success and failure alike).",
+    ["controller"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60))
+
+# Reconcile durations are reported from the runtime metrics hook (that layer
+# never imports prometheus) and buffered here until the next scrape drains
+# them into RECONCILE_DURATION — the OPERATION_WAIT idiom. Bounded: under
+# scrape starvation the buffer drops the oldest samples rather than growing.
+_MAX_PENDING_DURATIONS = 4096
+_pending_reconcile_durations: list[tuple[str, float]] = []
+
+
+def record_reconcile_duration(controller: str, seconds: float) -> None:
+    _pending_reconcile_durations.append((controller, seconds))
+    if len(_pending_reconcile_durations) > _MAX_PENDING_DURATIONS:
+        del _pending_reconcile_durations[:_MAX_PENDING_DURATIONS // 2]
+
+
+def drain_reconcile_durations() -> list[tuple[str, float]]:
+    out = _pending_reconcile_durations[:]
+    _pending_reconcile_durations.clear()
+    return out
+
 WORKQUEUE_DEPTH = _get_or_create(
     Gauge, "tpu_provisioner_workqueue_depth",
     "Items ready for a worker right now.", ["controller"])
@@ -231,6 +256,8 @@ def update_runtime_gauges(manager) -> None:
     # never imports prometheus) and drain into the histogram at scrape
     for kind, seconds in ops.drain_operation_waits():
         OPERATION_WAIT.labels(kind).observe(seconds)
+    for controller, seconds in drain_reconcile_durations():
+        RECONCILE_DURATION.labels(controller).observe(seconds)
     from . import health as _health
     REPAIR_STARTED.set(_health.REPAIR_STATS["started"])
     REPAIR_SUCCEEDED.set(_health.REPAIR_STATS["succeeded"])

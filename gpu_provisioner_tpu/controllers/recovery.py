@@ -34,6 +34,7 @@ GC): reaping on a wedged informer would delete live capacity.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from dataclasses import dataclass
 from typing import Optional
@@ -75,10 +76,17 @@ class RecoveryController:
     NAME = "operator.recovery"
 
     def __init__(self, client: Client, cloudprovider,
-                 options: Optional[RecoveryOptions] = None):
+                 options: Optional[RecoveryOptions] = None,
+                 recorder=None, tracer=None):
         self.client = client
         self.cp = cloudprovider
         self.opts = options or RecoveryOptions()
+        # Recorder + claimtrace tracer (both optional): an adoption is one
+        # of the lifecycle moments that used to log only — it now emits an
+        # Event carrying the trace id, and re-anchors the adopted claim's
+        # trace (the pre-crash trace died with the old incarnation's store).
+        self.recorder = recorder
+        self.tracer = tracer
         # count each (fate, resource) once per incarnation, not once per pass
         self._counted: set[tuple[str, str, str]] = set()
 
@@ -87,6 +95,15 @@ class RecoveryController:
         # InstanceProvider behind the metrics decorator (both the decorator
         # and the bare TPUCloudProvider expose .instances)
         return self.cp.instances
+
+    async def _publish(self, obj, etype, reason, message) -> None:
+        if self.recorder is not None:
+            await self.recorder.publish(obj, etype, reason, message)
+
+    def _span(self, claim: str, name: str, **attrs):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(claim, name, **attrs)
 
     async def run_once(self) -> float:
         try:
@@ -130,11 +147,30 @@ class RecoveryController:
                 # (batched polling + completion wake) so resumption never
                 # blind-waits; with no tracker wired the lifecycle re-drive
                 # resumes it through conflict adoption instead.
+                resumed = False
                 if pool.status != NP_ERROR:
-                    provider.resume_create(pool.name,
-                                           pool.initial_node_count)
-                self._count("pool", pool.name, RECOVERY_ADOPTED,
-                            "adopting half-created pool")
+                    resumed = provider.resume_create(pool.name,
+                                                     pool.initial_node_count)
+                if not self._count("pool", pool.name, RECOVERY_ADOPTED,
+                                   "adopting half-created pool"):
+                    continue
+                # Re-anchor the claim's trace (the pre-crash one died with
+                # the old store) and surface the adoption as an Event — it
+                # used to be visible only in this controller's log line.
+                if self.tracer is not None:
+                    self.tracer.reanchor(pool.name, uid=nc.metadata.uid,
+                                         pool_status=pool.status)
+                with self._span(pool.name, "adopt", pool_status=pool.status):
+                    if resumed:
+                        await self._publish(
+                            nc, "Normal", "LROAdopted",
+                            f"adopted in-flight create LRO for pool "
+                            f"{pool.name} ({pool.status}) on restart")
+                    else:
+                        await self._publish(
+                            nc, "Normal", "CreateResumed",
+                            f"create of pool {pool.name} ({pool.status}) "
+                            "resumed after restart via lifecycle re-drive")
 
         for qr in queued:
             nc = claims.get(qr.name)
@@ -142,8 +178,14 @@ class RecoveryController:
                 await self._reap_qr(qr.name)
             elif (qr.state != QR_ACTIVE
                   and nc.metadata.deletion_timestamp is None):
-                self._count("qr", qr.name, RECOVERY_RESUMED,
-                            "resuming queued-resource ladder")
+                if not self._count("qr", qr.name, RECOVERY_RESUMED,
+                                   "resuming queued-resource ladder"):
+                    continue
+                with self._span(qr.name, "adopt", qr_state=qr.state):
+                    await self._publish(
+                        nc, "Normal", "CreateResumed",
+                        f"queued-resource ladder for {qr.name} "
+                        f"({qr.state}) resumed after restart")
 
     def _young(self, pool) -> bool:
         if self.opts.grace <= 0:
@@ -157,17 +199,20 @@ class RecoveryController:
         # (fresh orphans that slip through fall to GC's observed-for grace)
         return (now() - created).total_seconds() - 1.0 < self.opts.grace
 
-    def _count(self, kind: str, name: str, counter, what: str) -> None:
+    def _count(self, kind: str, name: str, counter, what: str) -> bool:
         # dedup per (fate, resource): the SAME resource can legitimately be
         # counted under different counters across passes (adopted at boot,
         # reaped after its claim dies) — only repeat observations of the
-        # same fate are suppressed
+        # same fate are suppressed. Returns True on the FIRST observation:
+        # the adoption Event + trace re-anchor key off it, so a later audit
+        # pass neither re-publishes nor resets the re-anchored trace.
         key = (counter._name, kind, name)
         if key in self._counted:
-            return
+            return False
         self._counted.add(key)
         counter.labels(kind).inc()
         log.info("recovery: %s %s", what, name)
+        return True
 
     async def _reap_pool(self, name: str) -> None:
         # provider.delete is the full teardown (queued cleanup first, then

@@ -21,7 +21,9 @@ from ..runtime.events import Recorder
 from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
-from .metrics import RECONCILE_RETRIES_EXHAUSTED, RECONCILE_TIMEOUTS
+from .metrics import (
+    RECONCILE_RETRIES_EXHAUSTED, RECONCILE_TIMEOUTS, record_reconcile_duration,
+)
 from .recovery import RecoveryController, RecoveryOptions
 from .slicegroup import SliceGroupController, group_requests
 from .termination import EvictionQueue, NodeTerminationController, TerminationOptions
@@ -64,6 +66,7 @@ def build_controllers(client: Client, cloudprovider,
                       crashes=None,
                       fence=None,
                       tracker=None,
+                      tracer=None,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -102,7 +105,16 @@ def build_controllers(client: Client, cloudprovider,
     seam) — a claim parked on ``Result(requeue_after=...)`` reconciles the
     tick its LRO resolves. Tracked operations are keyed by pool name ==
     claim name, so the injected request lands on the right shard's
-    controller by construction (foreign shards never see the tracker)."""
+    controller by construction (foreign shards never see the tracker).
+
+    ``tracer`` (observability.Tracer): claimtrace wiring. Per-object
+    controllers get a reconcile span seam (queue-wait + reconcile spans,
+    trace/span ids in every log line and Event emitted underneath);
+    singletons are excluded — their self-requeuing tick is not claim work.
+    When a tracker is present its completions also back-fill the
+    ``lro:create``/``lro:delete`` and LRO-side ``node-wait`` spans from the
+    operation timestamps, which no coroutine awaits across (the whole point
+    of non-blocking mode)."""
     if not 0 <= shard_index < shards:
         raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
     owns = (lambda name: True) if shards == 1 else \
@@ -122,7 +134,7 @@ def build_controllers(client: Client, cloudprovider,
         return [Request(name=node.metadata.name)] if mine else []
 
     lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
-                                            lifecycle_options)
+                                            lifecycle_options, tracer=tracer)
     eviction = EvictionQueue(client, recorder=recorder)
     termination = NodeTerminationController(client, cloudprovider, eviction,
                                             recorder, termination_options,
@@ -138,6 +150,8 @@ def build_controllers(client: Client, cloudprovider,
     if tracker is not None:
         # early wake: tracked-operation completion → lifecycle workqueue
         tracker.subscribe(lambda op: lifecycle_controller.inject(op.name))
+    if tracker is not None and tracer is not None:
+        tracker.subscribe(lambda op: _record_operation_spans(tracer, op))
     controllers = [
         lifecycle_controller,
         Controller(termination.NAME, termination, max_concurrent=16,
@@ -148,7 +162,8 @@ def build_controllers(client: Client, cloudprovider,
         instance_gc = InstanceGCController(client, cloudprovider, gc_options)
         nodeclaim_gc = NodeClaimGCController(client, cloudprovider,
                                              gc_options)
-        recovery = RecoveryController(client, cloudprovider, recovery_options)
+        recovery = RecoveryController(client, cloudprovider, recovery_options,
+                                      recorder=recorder, tracer=tracer)
         controllers += [
             Controller(instance_gc.NAME, Singleton(instance_gc.run_once),
                        max_concurrent=1).as_singleton(),
@@ -176,15 +191,43 @@ def build_controllers(client: Client, cloudprovider,
             Controller(health.NAME, health, max_concurrent=8, **hardening)
             .watches(Node, map_fn=node_map))
     exhausted_hook = _make_exhausted_hook(client, recorder)
+    trace_seam = None
+    if tracer is not None:
+        trace_seam = (lambda name, req, queue_wait:
+                      tracer.reconcile_span(name, req.name,
+                                            queue_wait=queue_wait))
     for c in controllers:
         c.set_metrics_hook(_reconcile_metrics_hook)
         c.set_exhausted_hook(exhausted_hook)
         c.fence = fence
+        # singletons reconcile a synthetic tick, not a claim — tracing
+        # them would grow one junk trace per singleton name
+        if trace_seam is not None and not c.singleton:
+            c.set_trace_seam(trace_seam)
     return controllers, eviction
+
+
+async def _record_operation_spans(tracer, op) -> None:
+    """Back-fill LRO spans from tracked-operation timestamps: nothing awaits
+    across an LRO in non-blocking mode, so there is no coroutine to wrap —
+    the spans are reconstructed when the tracker resolves the operation. A
+    create op completes only once its nodes carry providerIDs; lro_done_at
+    (first RUNNING/RECONCILING poll) splits that wait into the LRO proper
+    and the node-join tail."""
+    end = op.completed_at
+    if not end:
+        return
+    lro_end = op.lro_done_at or end
+    tracer.record_span(op.name, f"lro:{op.kind}", op.started, lro_end,
+                       reason=op.reason, phase=op.phase)
+    if op.lro_done_at and end > op.lro_done_at:
+        tracer.record_span(op.name, "node-wait", op.lro_done_at, end,
+                           hosts=op.hosts)
 
 
 def _reconcile_metrics_hook(controller: str, duration: float,
                             err: Optional[str]) -> None:
+    record_reconcile_duration(controller, duration)
     if err == "ReconcileTimeout":
         RECONCILE_TIMEOUTS.labels(controller).inc()
 

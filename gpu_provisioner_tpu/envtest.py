@@ -137,6 +137,13 @@ class EnvtestOptions:
     stall_budget: float = 1.0
     stall_interval: float = 0.05
     leak_check: bool = True
+    # claimtrace (observability/): per-claim lifecycle traces, ON by default
+    # — the tracer is passive (contextvar + ring buffer, no background
+    # tasks), so every envtest run carries waterfalls for free and the bench
+    # gates its overhead. tracing=False builds the overhead baseline.
+    tracing: bool = True
+    trace_buffer: int = 512
+    trace_max_spans: int = 256
 
 
 def _make_cloud(opts: EnvtestOptions, client: InMemoryClient) -> FakeCloud:
@@ -190,6 +197,19 @@ class Env:
             kube.add_index(Node, "spec.providerID",
                            lambda o: [o.spec.provider_id])
             self.informers = kube
+        self.tracer = None
+        self.trace_store = None
+        trace_ids = None
+        if self.opts.tracing:
+            from .observability import (
+                Tracer, TraceStore, current_ids, install_log_record_factory,
+            )
+            self.trace_store = TraceStore(
+                max_traces=self.opts.trace_buffer,
+                max_spans=self.opts.trace_max_spans)
+            self.tracer = Tracer(self.trace_store)
+            install_log_record_factory()
+            trace_ids = current_ids
         self.provider = InstanceProvider(
             self.cloud.nodepools, kube,
             ProviderConfig(
@@ -199,7 +219,7 @@ class Env:
                 qr_cache_ttl=0.0,
                 cache_negative_ttl=self.opts.instance_cache_negative_ttl),
             queued=self.cloud.queuedresources,
-            crashes=self.opts.crashes, fence=fence)
+            crashes=self.opts.crashes, fence=fence, tracer=self.tracer)
         self.tracker = None
         if not self.opts.blocking_create:
             # the tracker polls through the provider's COUNTED seam so its
@@ -213,7 +233,7 @@ class Env:
             self.provider.tracker = self.tracker
         self.cloudprovider = MetricsDecorator(TPUCloudProvider(
             self.provider, repair_toleration=self.opts.repair_toleration))
-        self.recorder = Recorder(self.client)
+        self.recorder = Recorder(self.client, trace_ids=trace_ids)
         controllers, self.eviction = build_controllers(
             kube, self.cloudprovider, self.recorder,
             lifecycle_options=self.opts.lifecycle,
@@ -242,7 +262,7 @@ class Env:
                 interval=self.opts.recovery_interval,
                 grace=self.opts.leak_grace),
             crashes=self.opts.crashes, fence=fence,
-            tracker=self.tracker)
+            tracker=self.tracker, tracer=self.tracer)
         self.manager = Manager(self.client).register(*controllers)
         # runtime detectors (analysis/detectors.py), armed in __aenter__
         self.stall = None

@@ -1,0 +1,21 @@
+"""claimtrace — per-claim lifecycle tracing with critical-path attribution.
+
+The package stitches the repo's other observability surfaces (metrics, JSON
+logs, Events, profiles) together by claim: one trace per claim UID, spans
+opened at the existing seams (reconcile, provider state-machine steps, LRO
+resolution, node wait), trace/span IDs injected into log records and Events
+while a span is active, and a critical-path analyzer that decomposes a
+wave's ready-wall into named phases (docs/OBSERVABILITY.md).
+"""
+
+from .critical_path import (analyze_trace, render_attribution,
+                            wave_attribution)
+from .tracing import (Span, Trace, TraceEvent, Tracer, TraceStore,
+                      current_ids, install_log_record_factory,
+                      render_waterfall)
+
+__all__ = [
+    "Span", "Trace", "TraceEvent", "Tracer", "TraceStore", "current_ids",
+    "install_log_record_factory", "render_waterfall",
+    "analyze_trace", "wave_attribution", "render_attribution",
+]

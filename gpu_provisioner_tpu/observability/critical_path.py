@@ -1,0 +1,158 @@
+"""Critical-path attribution: decompose a ready-wall into named phases.
+
+BENCH_pr02 reconstructed "where does the wave's wall-clock go" by hand from
+endpoint counters; this module does it mechanically from a claim's trace. A
+claim's timeline [wave-start, ready] is partitioned by a priority sweep over
+its span intervals:
+
+    status-write > qr-wait > cloud-call > node-wait > lro > queue-wait
+        > reconcile
+
+Time covered by nothing is the **requeue-idle-gap** — the claim existed and
+nobody was working on it (parked on ``Result(requeue_after=...)``, or
+waiting for its watch event to be pumped). Time covered *only* by a
+reconcile span (controller body work with no named sub-phase) is
+**reconcile-exec** and counts as unattributed: the attribution gate in the
+bench asserts the named phases + idle-gap explain ≥ 95% of the wall, which
+is only meaningful if "in a reconcile doing something we didn't name" can
+fail it.
+
+``node-wait`` is usually *derived*: in the non-blocking provisioning path no
+code sits in a node-wait loop, so the phase is the interval from the create
+LRO's resolution to the claim's ``registered`` annotation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .tracing import Trace
+
+# Higher priority wins where intervals overlap (a status-write inside a
+# reconcile inside the claim's LRO window is status-write time).
+_PRIORITY = {
+    "status-write": 7,
+    "qr-wait": 6,
+    "cloud-call": 5,
+    "node-wait": 4,
+    "lro": 3,
+    "queue-wait": 2,
+    "reconcile": 1,
+}
+
+IDLE = "requeue-idle-gap"
+UNATTRIBUTED = "reconcile-exec"
+
+# Phases that count toward the attribution gate. IDLE is named — "the claim
+# sat in requeue backoff" is an answer, and the one the coalesced-status
+# work needs. UNATTRIBUTED is deliberately not.
+NAMED_PHASES = ("queue-wait", "lro", "node-wait", "qr-wait", "cloud-call",
+                "status-write", IDLE)
+
+
+def classify(span_name: str) -> Optional[str]:
+    """Span name → phase, or None for spans the sweep ignores."""
+    base = span_name.split(":", 1)[0]
+    if base in ("queue-wait", "qr-wait", "status-write", "node-wait", "lro"):
+        return base
+    if base in ("begin-create", "begin-delete", "delete-queued"):
+        return "cloud-call"
+    if base == "reconcile":
+        return "reconcile"
+    return None
+
+
+def _intervals(trace: Trace) -> list[tuple[float, float, str]]:
+    out: list[tuple[float, float, str]] = []
+    lro_ends: list[float] = []
+    for s in trace.spans:
+        phase = classify(s.name)
+        if phase is None or s.end <= s.start:
+            continue
+        out.append((s.start, s.end, phase))
+        if phase == "lro" and "create" in s.name:
+            lro_ends.append(s.end)
+    # Derived node-wait: create-LRO resolution → registered annotation.
+    registered = [e.at for e in trace.events if e.name == "registered"]
+    if lro_ends and registered:
+        start, end = max(lro_ends), max(registered)
+        if end > start:
+            out.append((start, end, "node-wait"))
+    return out
+
+
+def analyze_trace(trace: Trace, t0: Optional[float] = None,
+                  until_event: str = "ready") -> Optional[dict]:
+    """Decompose one claim's [t0, ready] window. Returns None when the
+    trace never reached ``until_event``."""
+    finishes = [e.at for e in trace.events if e.name == until_event]
+    if not finishes:
+        return None
+    ready = max(finishes)
+    if t0 is None:
+        t0 = trace.t0()
+    if t0 is None or ready <= t0:
+        return None
+
+    ivals = [(max(s, t0), min(e, ready), p)
+             for s, e, p in _intervals(trace) if e > t0 and s < ready]
+    points = sorted({t0, ready, *(p for iv in ivals for p in iv[:2])})
+    phases: dict[str, float] = {}
+    for lo, hi in zip(points, points[1:]):
+        mid = (lo + hi) / 2
+        best, best_pri = IDLE, 0
+        for s, e, p in ivals:
+            if s <= mid < e and _PRIORITY[p] > best_pri:
+                best, best_pri = p, _PRIORITY[p]
+        if best == "reconcile":
+            best = UNATTRIBUTED
+        phases[best] = phases.get(best, 0.0) + (hi - lo)
+
+    wall = ready - t0
+    attributed = sum(phases.get(p, 0.0) for p in NAMED_PHASES)
+    return {
+        "claim": trace.claim,
+        "wall": wall,
+        "ready_at": ready,
+        "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "attributed_fraction": attributed / wall,
+    }
+
+
+def wave_attribution(traces: Iterable[Trace], t0: float,
+                     until_event: str = "ready") -> Optional[dict]:
+    """Wave-level view: the ready-wall is set by the last claim to go
+    Ready, so the headline decomposition is that *critical* claim's
+    timeline over [wave-start, last-ready]. Aggregate per-phase means over
+    every finished claim ride along for the non-critical picture."""
+    per_claim = [r for r in (analyze_trace(tr, t0=t0, until_event=until_event)
+                             for tr in traces) if r is not None]
+    if not per_claim:
+        return None
+    critical = max(per_claim, key=lambda r: r["ready_at"])
+    n = len(per_claim)
+    agg: dict[str, float] = {}
+    for r in per_claim:
+        for k, v in r["phases"].items():
+            agg[k] = agg.get(k, 0.0) + v
+    return {
+        "claims": n,
+        "wall": round(critical["wall"], 6),
+        "critical_claim": critical["claim"],
+        "phases": critical["phases"],
+        "attributed_fraction": round(critical["attributed_fraction"], 6),
+        "mean_phases": {k: round(v / n, 6) for k, v in sorted(agg.items())},
+    }
+
+
+def render_attribution(result: dict) -> str:
+    """The ``make trace`` summary table."""
+    wall = result["wall"]
+    rows = [f"critical-path attribution: {result['claims']} claim(s), "
+            f"wall {wall:.3f}s, critical claim {result['critical_claim']}"]
+    for name, secs in sorted(result["phases"].items(),
+                             key=lambda kv: -kv[1]):
+        rows.append(f"  {name:<18} {secs:8.3f}s  {100 * secs / wall:5.1f}%")
+    rows.append(f"  {'attributed':<18} {'':8}  "
+                f"{100 * result['attributed_fraction']:5.1f}%")
+    return "\n".join(rows)

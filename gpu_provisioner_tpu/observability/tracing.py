@@ -1,0 +1,359 @@
+"""In-process span tracer: bounded TraceStore + contextvar propagation.
+
+Design constraints, in order:
+
+- **Passive.** No background tasks, no flush loops — recording is a list
+  append under the event loop's implicit serialization, so the envtest
+  task-leak gate needs no new tracked components and teardown order cannot
+  deadlock on a tracer.
+- **No open spans across tasks.** A span opened by one task and closed by
+  another (the LRO poller resolves what ``create`` started) would leak
+  contextvars between unrelated reconciles. Cross-task phases are recorded
+  as *completed* spans from their known timestamps (``record_span``);
+  ``span_begin``/``span_end`` pairs stay within one task and are policed by
+  provlint PL012 (must be closed via context manager or try/finally).
+- **Same clock as the operation tracker.** Timestamps use the running
+  loop's clock (``providers.operations.loop_now`` semantics, duplicated
+  here so observability imports nothing above ``logging``/stdlib) so spans
+  recorded from ``TrackedOperation.started/completed_at`` line up with
+  spans the tracer stamped itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+import uuid
+from collections import OrderedDict
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+# (trace_id, span_id) of the innermost active span in this task, or None.
+# Read by the log-record factory and the event Recorder's trace_ids seam.
+_CURRENT: ContextVar[Optional[tuple[str, str]]] = ContextVar(
+    "claimtrace_current", default=None)
+
+
+def current_ids() -> Optional[tuple[str, str]]:
+    """The active (trace_id, span_id), or None outside any span."""
+    return _CURRENT.get()
+
+
+def _mono() -> float:
+    """Loop clock inside async contexts, ``time.monotonic`` outside — the
+    same seam as ``providers.operations.loop_now`` so tracker-sourced span
+    timestamps and tracer-stamped ones share a time base."""
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class Span:
+    """One closed interval inside a trace. ``end`` is stamped at close; a
+    span only enters ``Trace.spans`` once closed (open spans live on the
+    ``_OpenSpan`` token), so readers never see a half-written interval."""
+
+    span_id: str
+    parent_id: str
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.end - self.start)
+
+
+@dataclass
+class TraceEvent:
+    """Zero-duration annotation (ready, registered, adopted-on-restart)."""
+
+    name: str
+    at: float
+    attrs: dict = field(default_factory=dict)
+
+
+class Trace:
+    """All spans + annotations for one claim, bounded to ``max_spans``."""
+
+    def __init__(self, claim: str, max_spans: int = 256):
+        self.claim = claim
+        self.trace_id = _new_id()
+        self.max_spans = max_spans
+        self.attrs: dict = {}
+        self.spans: list[Span] = []
+        self.events: list[TraceEvent] = []
+        self.dropped_spans = 0
+
+    def add_span(self, span: Span) -> None:
+        if len(self.spans) >= self.max_spans:
+            self.dropped_spans += 1
+            return
+        self.spans.append(span)
+
+    def add_event(self, ev: TraceEvent) -> None:
+        if len(self.events) >= self.max_spans:
+            self.dropped_spans += 1
+            return
+        self.events.append(ev)
+
+    def t0(self) -> Optional[float]:
+        starts = [s.start for s in self.spans] + [e.at for e in self.events]
+        return min(starts) if starts else None
+
+    def to_dict(self) -> dict:
+        """JSON shape served by ``/traces/{claim}`` — offsets are relative
+        to the trace's first timestamp (monotonic values mean nothing to a
+        client)."""
+        t0 = self.t0() or 0.0
+        return {
+            "claim": self.claim,
+            "trace_id": self.trace_id,
+            "attrs": dict(self.attrs),
+            "dropped_spans": self.dropped_spans,
+            "spans": [{
+                "span_id": s.span_id, "parent_id": s.parent_id,
+                "name": s.name,
+                "start": round(s.start - t0, 6),
+                "duration": round(s.duration, 6),
+                "attrs": dict(s.attrs),
+            } for s in sorted(self.spans, key=lambda s: s.start)],
+            "events": [{
+                "name": e.name, "at": round(e.at - t0, 6),
+                "attrs": dict(e.attrs),
+            } for e in sorted(self.events, key=lambda e: e.at)],
+        }
+
+    def summary(self) -> dict:
+        """Ring-listing shape served by ``/traces``."""
+        t0 = self.t0()
+        ends = [s.end for s in self.spans] + [e.at for e in self.events]
+        return {
+            "claim": self.claim, "trace_id": self.trace_id,
+            "spans": len(self.spans), "events": len(self.events),
+            "span_window": round(max(ends) - t0, 6) if t0 is not None else 0.0,
+            "attrs": dict(self.attrs),
+        }
+
+
+class TraceStore:
+    """Bounded ring buffer of traces keyed by claim name: inserting past
+    ``max_traces`` evicts the oldest trace. Single-event-loop discipline —
+    all mutation happens on the operator loop, so no lock."""
+
+    def __init__(self, max_traces: int = 512, max_spans: int = 256):
+        self.max_traces = max_traces
+        self.max_spans = max_spans
+        self._traces: "OrderedDict[str, Trace]" = OrderedDict()
+        self.evicted_total = 0
+
+    def get_or_create(self, claim: str) -> Trace:
+        tr = self._traces.get(claim)
+        if tr is None:
+            tr = Trace(claim, max_spans=self.max_spans)
+            self._traces[claim] = tr
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+                self.evicted_total += 1
+        return tr
+
+    def get(self, claim: str) -> Optional[Trace]:
+        return self._traces.get(claim)
+
+    def replace(self, claim: str) -> Trace:
+        """Drop any existing trace for ``claim`` and start a fresh one —
+        the restart re-anchor path (a new process owns a new trace_id; the
+        old trace died with the old process's store anyway, but a
+        RestartableEnv shares nothing either, so this is belt-and-braces
+        for callers that re-adopt within one store)."""
+        self._traces.pop(claim, None)
+        return self.get_or_create(claim)
+
+    def traces(self) -> list[Trace]:
+        return list(self._traces.values())
+
+    def recent(self, n: int = 50) -> list[Trace]:
+        return list(self._traces.values())[-n:]
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+
+class _OpenSpan:
+    """Token returned by ``span_begin``; holds the contextvar reset token so
+    nesting restores the parent span on close."""
+
+    __slots__ = ("trace", "span", "cv_token")
+
+    def __init__(self, trace: Trace, span: Span, cv_token):
+        self.trace = trace
+        self.span = span
+        self.cv_token = cv_token
+
+
+class Tracer:
+    """The recording API threaded through controllers/providers/registry.
+
+    Every method is a cheap no-op when the tracer is constructed with
+    ``enabled=False`` (the bench overhead baseline measures against a
+    *disabled* tracer as well as a ``None`` one — both paths must be free).
+    """
+
+    def __init__(self, store: Optional[TraceStore] = None,
+                 enabled: bool = True):
+        self.store = store if store is not None else TraceStore()
+        self.enabled = enabled
+
+    # -- manual pair (PL012: must be closed via try/finally) ---------------
+    def span_begin(self, claim: str, name: str, **attrs) -> Optional[_OpenSpan]:
+        if not self.enabled:
+            return None
+        tr = self.store.get_or_create(claim)
+        cur = _CURRENT.get()
+        parent = cur[1] if cur is not None and cur[0] == tr.trace_id else ""
+        sp = Span(span_id=_new_id(), parent_id=parent, name=name,
+                  start=_mono(), attrs=dict(attrs))
+        cv_token = _CURRENT.set((tr.trace_id, sp.span_id))
+        return _OpenSpan(tr, sp, cv_token)
+
+    def span_end(self, token: Optional[_OpenSpan], **attrs) -> None:
+        if token is None:
+            return
+        token.span.end = _mono()
+        if attrs:
+            token.span.attrs.update(attrs)
+        token.trace.add_span(token.span)
+        _CURRENT.reset(token.cv_token)
+
+    # -- context-manager form (the one real code uses) ---------------------
+    @contextlib.contextmanager
+    def span(self, claim: str, name: str, **attrs) -> Iterator[Optional[_OpenSpan]]:
+        token = self.span_begin(claim, name, **attrs)
+        try:
+            yield token
+        finally:
+            self.span_end(token)
+
+    @contextlib.contextmanager
+    def reconcile_span(self, controller: str, claim: str,
+                       queue_wait: Optional[float] = None
+                       ) -> Iterator[Optional[_OpenSpan]]:
+        """The controller trace seam body: record the queue-wait that ended
+        at this dequeue as a completed span, then cover the reconcile."""
+        if self.enabled and queue_wait is not None and queue_wait > 0:
+            end = _mono()
+            self.record_span(claim, "queue-wait", end - queue_wait, end,
+                             controller=controller)
+        token = self.span_begin(claim, f"reconcile:{controller}",
+                                controller=controller)
+        try:
+            yield token
+        finally:
+            self.span_end(token)
+
+    # -- cross-task phases with known timestamps ---------------------------
+    def record_span(self, claim: str, name: str, start: float, end: float,
+                    parent_id: str = "", **attrs) -> None:
+        """Record an already-completed interval (LRO resolution from the
+        tracker's ``started``/``completed_at``, queue-wait from the
+        workqueue's enqueue stamp). Never touches the contextvar."""
+        if not self.enabled:
+            return
+        tr = self.store.get_or_create(claim)
+        tr.add_span(Span(span_id=_new_id(), parent_id=parent_id, name=name,
+                         start=start, end=max(end, start), attrs=dict(attrs)))
+
+    def annotate(self, claim: str, name: str, **attrs) -> None:
+        """Zero-duration trace event (ready, registered, adopted)."""
+        if not self.enabled:
+            return
+        self.store.get_or_create(claim).add_event(
+            TraceEvent(name=name, at=_mono(), attrs=dict(attrs)))
+
+    def set_trace_attrs(self, claim: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        self.store.get_or_create(claim).attrs.update(attrs)
+
+    def reanchor(self, claim: str, **attrs) -> None:
+        """Restart re-anchor: start a fresh trace for an adopted claim (the
+        pre-crash trace died with the old process) and mark the adoption so
+        the waterfall shows the discontinuity."""
+        if not self.enabled:
+            return
+        tr = self.store.replace(claim)
+        tr.attrs.update(attrs)
+        tr.attrs["reanchored"] = True
+        tr.add_event(TraceEvent(name="adopted-on-restart", at=_mono(),
+                                attrs=dict(attrs)))
+
+
+# ------------------------------------------------------------ log stitching
+
+def install_log_record_factory() -> None:
+    """Stamp ``trace_id``/``span_id`` on every LogRecord created while a
+    span is active. Record-creation-time stamping means caplog sees the ids
+    in tests and the JSONFormatter's generic extra-attr loop emits them
+    with no formatter change. Idempotent — wrapping twice would stamp
+    twice-removed factories forever."""
+    old = logging.getLogRecordFactory()
+    if getattr(old, "_claimtrace", False):
+        return
+
+    def factory(*args, **kwargs):
+        record = old(*args, **kwargs)
+        cur = _CURRENT.get()
+        if cur is not None:
+            record.trace_id, record.span_id = cur
+        return record
+
+    factory._claimtrace = True
+    logging.setLogRecordFactory(factory)
+
+
+# ------------------------------------------------------------- waterfall
+
+def render_waterfall(trace: Trace, width: int = 48) -> str:
+    """Plain-text waterfall for ``/traces/{claim}?format=text`` and the
+    ``make trace`` summary: one bar per span scaled to the trace window,
+    annotations as point markers."""
+    t0 = trace.t0()
+    rows: list[str] = [
+        f"claim={trace.claim} trace={trace.trace_id} "
+        + " ".join(f"{k}={v}" for k, v in sorted(trace.attrs.items()))]
+    if t0 is None:
+        rows.append("  (no spans recorded)")
+        return "\n".join(rows)
+    ends = [s.end for s in trace.spans] + [e.at for e in trace.events]
+    window = max(max(ends) - t0, 1e-9)
+    items: list[tuple[float, str]] = []
+    for s in sorted(trace.spans, key=lambda s: s.start):
+        off, dur = s.start - t0, s.duration
+        lo = int((off / window) * width)
+        hi = max(lo + 1, int(((off + dur) / window) * width))
+        bar = " " * lo + "█" * min(hi - lo, width - lo)
+        attrs = " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
+        items.append((off, f"  {off * 1000:9.1f}ms {dur * 1000:9.1f}ms "
+                           f"|{bar:<{width}}| {s.name}"
+                           + (f" [{attrs}]" if attrs else "")))
+    for e in sorted(trace.events, key=lambda e: e.at):
+        off = e.at - t0
+        lo = min(int((off / window) * width), width - 1)
+        bar = " " * lo + "▼"
+        items.append((off, f"  {off * 1000:9.1f}ms {'·':>11} "
+                           f"|{bar:<{width}}| @{e.name}"))
+    rows += [line for _, line in sorted(items, key=lambda t: t[0])]
+    if trace.dropped_spans:
+        rows.append(f"  ({trace.dropped_spans} spans dropped at the "
+                    f"{trace.max_spans}-span trace bound)")
+    return "\n".join(rows)

@@ -51,11 +51,14 @@ async def run_simulate(opts) -> int:
     env_opts.max_concurrent_reconciles = opts.max_concurrent_reconciles
     env_opts.shards = opts.shards
     env_opts.shard_index = opts.shard_index
+    env_opts.tracing = opts.tracing_enabled
+    env_opts.trace_buffer = opts.trace_buffer
 
     async with Env(env_opts) as env:
         runners = await start_servers(env.manager, opts.metrics_port,
                                       opts.health_probe_port,
-                                      opts.enable_profiling)
+                                      opts.enable_profiling,
+                                      trace_store=env.trace_store)
         log.info("simulated operator up",
                  extra={"metrics_port": opts.metrics_port,
                         "health_port": opts.health_probe_port})
@@ -165,11 +168,21 @@ async def run_real(opts) -> int:
     queued = CloudTPUQueuedResourcesClient(
         cred, cfg.project_id, cfg.location,
         endpoint=cfg.tpu_api_endpoint or gcprest.TPU_ENDPOINT)
+    from ..observability import Tracer, TraceStore, current_ids
+
+    # claimtrace: passive per-claim span tracer (bounded ring buffer,
+    # no background tasks) served at /traces on the metrics port
+    tracer = trace_store = trace_ids = None
+    if opts.tracing_enabled:
+        trace_store = TraceStore(max_traces=opts.trace_buffer)
+        tracer = Tracer(trace_store)
+        trace_ids = current_ids
+
     provider = InstanceProvider(
         nodepools, kube,
         ProviderConfig(project=cfg.project_id, zone=cfg.location,
                        cluster=cfg.cluster_name),
-        queued=queued)
+        queued=queued, tracer=tracer)
     from ..providers.operations import OperationTracker
 
     # Non-blocking provisioning: one background poller multiplexes every
@@ -189,7 +202,7 @@ async def run_real(opts) -> int:
         registration_timeout=opts.registration_timeout_seconds,
         termination_requeue=opts.termination_requeue_seconds)
     controllers, eviction = build_controllers(
-        kube, cloudprovider, Recorder(kube),
+        kube, cloudprovider, Recorder(kube, trace_ids=trace_ids),
         lifecycle_options=lifecycle,
         termination_options=TerminationOptions(
             instance_requeue=opts.instance_requeue_seconds),
@@ -210,7 +223,7 @@ async def run_real(opts) -> int:
         node_repair=opts.feature_gates.node_repair,
         cluster=cfg.cluster_name,
         shards=opts.shards, shard_index=opts.shard_index,
-        tracker=tracker)
+        tracker=tracker, tracer=tracer)
     manager = Manager(kube).register(*controllers)
 
     stop = asyncio.Event()
@@ -244,7 +257,8 @@ async def run_real(opts) -> int:
     await manager.start()
     runners = await start_servers(manager, opts.metrics_port,
                                   opts.health_probe_port,
-                                  opts.enable_profiling)
+                                  opts.enable_profiling,
+                                  trace_store=trace_store)
     log.info("operator up", extra={"project": cfg.project_id,
                                    "location": cfg.location,
                                    "cluster": cfg.cluster_name})

@@ -36,6 +36,11 @@ class JSONFormatter(logging.Formatter):
 
 
 def setup_logging(level: str = "info", stream=None) -> None:
+    # claimtrace correlation: every record emitted under an active span
+    # carries trace_id/span_id attrs, which the generic extra-field loop
+    # above serializes into the JSON line with no formatter change
+    from ..observability import install_log_record_factory
+    install_log_record_factory()
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(JSONFormatter())
     root = logging.getLogger()

@@ -71,6 +71,11 @@ class Options:
     # active-passive.
     shards: int = 1
     shard_index: int = 0
+    # claimtrace (observability/): per-claim lifecycle traces served at
+    # /traces on the metrics port. Default on — the tracer is passive
+    # (bounded ring buffer, no background tasks).
+    tracing_enabled: bool = True
+    trace_buffer: int = 512
     simulate: bool = False
     simulate_claims: int = 0
     simulate_shape: str = "tpu-v5e-8"
@@ -147,6 +152,8 @@ def parse_options(argv=None, env=None) -> Options:
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
         shards=int(e.get("SHARDS", "1")),
         shard_index=_shard_index_env(e),
+        tracing_enabled=_env_bool(e, "TRACING_ENABLED", True),
+        trace_buffer=int(e.get("TRACE_BUFFER", "512")),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)
 
@@ -159,6 +166,10 @@ def parse_options(argv=None, env=None) -> Options:
     p.add_argument("--feature-gates", default="")
     p.add_argument("--shards", type=int, default=o.shards)
     p.add_argument("--shard-index", type=int, default=o.shard_index)
+    p.add_argument("--disable-tracing", action="store_true",
+                   default=not o.tracing_enabled,
+                   help="turn off claimtrace (per-claim lifecycle traces)")
+    p.add_argument("--trace-buffer", type=int, default=o.trace_buffer)
     p.add_argument("--simulate", action="store_true",
                    help="run against the in-process simulated cloud (envtest)")
     p.add_argument("--simulate-claims", type=int, default=0,
@@ -173,6 +184,8 @@ def parse_options(argv=None, env=None) -> Options:
     o.feature_gates = parse_feature_gates(args.feature_gates, o.feature_gates)
     o.shards = args.shards
     o.shard_index = args.shard_index
+    o.tracing_enabled = not args.disable_tracing
+    o.trace_buffer = args.trace_buffer
     if not 0 <= o.shard_index < o.shards:
         p.error(f"--shard-index {o.shard_index} outside [0, {o.shards})")
     o.simulate = args.simulate

@@ -6,6 +6,10 @@ watch caches started and required kinds are registered — the analog of the
 reference's cache-sync + NodeClaim-CRD-presence readyz, operator.go:207-224).
 pprof analog behind --enable-profiling: /debug/tasks dumps live asyncio tasks
 with stacks (operator.go:185-200 exposes Go pprof there).
+
+Claimtrace surface (observability/): when a TraceStore is wired, /traces
+returns recent trace summaries and /traces/{claim} the full waterfall (JSON;
+``?format=text`` renders the plain-text bars).
 """
 
 from __future__ import annotations
@@ -41,7 +45,8 @@ BUILD_INFO.labels(version=__version__,
                   python_version=platform.python_version()).set(1)
 
 
-def build_apps(manager: Manager, enable_profiling: bool = False):
+def build_apps(manager: Manager, enable_profiling: bool = False,
+               trace_store=None):
     metrics = web.Application()
 
     async def metrics_handler(_req):
@@ -52,6 +57,28 @@ def build_apps(manager: Manager, enable_profiling: bool = False):
                             content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
     metrics.router.add_get("/metrics", metrics_handler)
+
+    if trace_store is not None:
+        from ..observability import render_waterfall
+
+        async def traces_handler(req):
+            try:
+                n = int(req.query.get("n", "50"))
+            except ValueError:
+                return web.Response(status=400, text="bad n")
+            return web.json_response(
+                {"traces": [t.summary() for t in trace_store.recent(n)]})
+
+        async def trace_handler(req):
+            trace = trace_store.get(req.match_info["claim"])
+            if trace is None:
+                return web.Response(status=404, text="no trace for claim")
+            if req.query.get("format") == "text":
+                return web.Response(text=render_waterfall(trace))
+            return web.json_response(trace.to_dict())
+
+        metrics.router.add_get("/traces", traces_handler)
+        metrics.router.add_get("/traces/{claim}", trace_handler)
 
     if enable_profiling:
         from . import profiling
@@ -102,8 +129,9 @@ def build_apps(manager: Manager, enable_profiling: bool = False):
 
 
 async def start_servers(manager: Manager, metrics_port: int, health_port: int,
-                        enable_profiling: bool = False):
-    metrics_app, health_app = build_apps(manager, enable_profiling)
+                        enable_profiling: bool = False, trace_store=None):
+    metrics_app, health_app = build_apps(manager, enable_profiling,
+                                         trace_store=trace_store)
     runners = []
     for app, port in ((metrics_app, metrics_port), (health_app, health_port)):
         runner = web.AppRunner(app, access_log=None)

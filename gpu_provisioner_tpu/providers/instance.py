@@ -28,6 +28,7 @@ pinned for a slice-create duration. The blocking shape survives tracker-less
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import re
 from collections import defaultdict
@@ -174,7 +175,8 @@ class InstanceProvider:
                  config: Optional[ProviderConfig] = None,
                  queued: Optional[QueuedResourcesAPI] = None,
                  crashes=None, fence=None,
-                 tracker: Optional[OperationTracker] = None):
+                 tracker: Optional[OperationTracker] = None,
+                 tracer=None):
         # every cloud seam is wrapped in a per-endpoint call counter so the
         # /metrics surface (and the bench harness) can see exactly what the
         # control loops cost the cloud APIs
@@ -198,6 +200,10 @@ class InstanceProvider:
         # batched nodepools.list per tick. With no tracker (direct/tooling
         # construction, the bench baseline) the blocking paths below remain.
         self.tracker = tracker
+        # claimtrace tracer (observability/tracing.py), duck-typed and
+        # optional: spans cover the create/delete state-machine steps so the
+        # critical-path analyzer can attribute a claim's ready-wall.
+        self.tracer = tracer
         # Read-through caches (providers/cache.py): point lookups on the
         # cloud seams, singleflight-coalesced, explicitly invalidated by
         # create/delete/state transitions below.
@@ -293,14 +299,16 @@ class InstanceProvider:
                 # None: a resolved delete freed the name — fresh create
 
         if self._queued_mode(nc, reqs):
-            await self._ensure_queued_resource(nc, shape, capacity_type)
+            with self._span(name, "qr-wait", shape=shape.slice_name):
+                await self._ensure_queued_resource(nc, shape, capacity_type)
 
         slice_identity = await self._slice_group_identity(nc)
         pool = self._new_nodepool_object(nc, shape, capacity_type,
                                          extra_labels=slice_identity)
         try:
             self._fence_check()
-            op = await self.nodepools.begin_create(pool)
+            with self._span(name, "begin-create", hosts=shape.hosts):
+                op = await self.nodepools.begin_create(pool)
             self._crash("after_pool_begin_create", name)
             if self.tracker is not None:
                 # hand the LRO + node wait to the multiplexer and free the
@@ -338,7 +346,8 @@ class InstanceProvider:
         # cut line: the create LRO has completed server-side but nothing —
         # cache invalidation, node wait, claim status — has recorded it yet
         self._crash("before_lro_done", name)
-        nodes = await self._wait_for_nodes(name, shape.hosts)
+        with self._span(name, "node-wait", hosts=shape.hosts):
+            nodes = await self._wait_for_nodes(name, shape.hosts)
         # state transition just happened (create LRO completed) — drop any
         # entry cached during the wait so the final read sees RUNNING
         self._pool_cache.invalidate(name)
@@ -444,6 +453,13 @@ class InstanceProvider:
     def _crash(self, point: str, key: str) -> None:
         if self.crashes is not None:
             self.crashes.hit(point, key)
+
+    def _span(self, claim: str, name: str, **attrs):
+        """Tracer span or a free no-op — the provider never requires the
+        observability package."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(claim, name, **attrs)
 
     def _fence_check(self) -> None:
         # Single-writer guard: raises FencedError for a deposed leader. The
@@ -821,7 +837,8 @@ class InstanceProvider:
         terminating"); subsequent calls consume the tracked outcome —
         in flight → return at zero further cloud calls, succeeded → the
         NodeClaimNotFoundError the finalizer is waiting for."""
-        await self.delete_queued(name)
+        with self._span(name, "delete-queued"):
+            await self.delete_queued(name)
         if self.tracker is not None:
             top = self.tracker.poke(name)
             if top is not None and top.kind == OP_DELETE:
@@ -863,7 +880,8 @@ class InstanceProvider:
             return
         try:
             self._fence_check()
-            op = await self.nodepools.begin_delete(name)
+            with self._span(name, "begin-delete"):
+                op = await self.nodepools.begin_delete(name)
             self._pool_cache.invalidate(name)  # state transition: Deleting
             # cut line: delete LRO issued (QR already cleaned up), unpolled
             self._crash("mid_delete_after_pool_delete", name)

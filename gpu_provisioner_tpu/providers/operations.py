@@ -175,6 +175,10 @@ class TrackedOperation:
     message: str = ""
     wait_seconds: float = 0.0
     completed_at: float = 0.0
+    # First tick the create's cloud-side LRO was observed resolved (pool
+    # RUNNING/RECONCILING) — splits the op's wait into its LRO and
+    # node-wait phases for claimtrace attribution. 0.0 = never observed.
+    lro_done_at: float = 0.0
     done: asyncio.Event = field(default_factory=asyncio.Event)
 
     @property
@@ -469,6 +473,8 @@ class OperationTracker:
         # RUNNING / RECONCILING: the LRO is done — now the node wait, off
         # the (informer-backed) kube client: watch-cache maintenance, not a
         # fresh apiserver LIST per op per tick
+        if op.lro_done_at == 0.0:
+            op.lro_done_at = _now()
         nodes = await self.kube.list(
             Node, labels={wk.GKE_NODEPOOL_LABEL: op.name})
         ready = sum(1 for n in nodes if n.spec.provider_id)

@@ -12,6 +12,7 @@ GC loops (SURVEY.md §3.4).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import logging
 import time
@@ -111,6 +112,8 @@ class Controller:
         self.fenced_total = 0
         self._metrics_hook: Optional[Callable[[str, float, Optional[str]], None]] = None
         self._exhausted_hook: Optional[Callable[[str, Request, int], Awaitable[None]]] = None
+        self._trace_seam: Optional[
+            Callable[[str, Request, Optional[float]], object]] = None
 
     def watches(self, cls: type, map_fn: Optional[MapFn] = None,
                 predicate: Optional[Predicate] = None) -> "Controller":
@@ -129,6 +132,15 @@ class Controller:
         exhausts ``max_retries`` (events/metrics live above the runtime
         layer; this seam keeps the dependency pointing upward)."""
         self._exhausted_hook = hook
+
+    def set_trace_seam(self, seam) -> None:
+        """``seam(controller_name, req, queue_wait_seconds) -> context
+        manager`` entered around each reconcile (same upward-pointing
+        dependency rule as the metrics/exhausted hooks: tracing lives above
+        the runtime layer). Because it is entered inside the worker task,
+        contextvars it sets propagate into every await the reconciler
+        makes — providers and clients see the active span."""
+        self._trace_seam = seam
 
     async def inject(self, name: str, namespace: str = "") -> None:
         """External wake-up seam: enqueue a reconcile for ``name`` NOW.
@@ -185,6 +197,9 @@ class Controller:
     async def _worker(self) -> None:
         while True:
             req = await self.queue.get()
+            # Always consume the queue-wait stamp (keeps the queue's wait
+            # map bounded) even when no trace seam is installed.
+            queue_wait = self.queue.pop_wait(req)
             if self.fence is not None and not self.fence.valid():
                 # Deposed leader: single-writer discipline beats progress.
                 # Forget as well as done: a deposed-then-re-elected
@@ -196,50 +211,59 @@ class Controller:
                 continue
             start = time.monotonic()
             err: Optional[str] = None
-            try:
-                result = await self._reconcile_once(req)
-            except asyncio.CancelledError:
-                # Shutdown cancellation must propagate; a CancelledError the
-                # RECONCILER leaked (a sub-task it spawned got cancelled) is
-                # isolated and retried. Task.cancelling() is 3.11+ — on 3.10
-                # the two are indistinguishable, so re-raise (pre-hardening
-                # behavior).
-                cancelling = getattr(asyncio.current_task(), "cancelling", None)
-                if cancelling is None or cancelling():
-                    raise
-                err = "Cancelled"
-                await self.queue.done(req)
-                await self._requeue_failed(req)
-            except Exception as e:  # reconcile errors → rate-limited requeue
-                # TimeoutError with a deadline configured = OUR wait_for
-                # fired (3.11+: asyncio.TimeoutError IS builtin TimeoutError;
-                # a reconciler-raised timeout with no deadline set stays a
-                # generic error).
-                if (isinstance(e, asyncio.TimeoutError)
-                        and self.reconcile_timeout is not None):
-                    err = "ReconcileTimeout"
-                    self.timeouts_total += 1
-                    log.warning(
-                        "controller=%s req=%s reconcile exceeded %.1fs "
-                        "deadline; cancelled and requeued", self.name, req,
-                        self.reconcile_timeout)
+            # The seam's context manager stays open across the requeue
+            # bookkeeping too, so warning logs on the error paths carry the
+            # reconcile's trace/span ids.
+            trace_ctx = (self._trace_seam(self.name, req, queue_wait)
+                         if self._trace_seam is not None
+                         else contextlib.nullcontext())
+            with trace_ctx:
+                try:
+                    result = await self._reconcile_once(req)
+                except asyncio.CancelledError:
+                    # Shutdown cancellation must propagate; a CancelledError
+                    # the RECONCILER leaked (a sub-task it spawned got
+                    # cancelled) is isolated and retried. Task.cancelling()
+                    # is 3.11+ — on 3.10 the two are indistinguishable, so
+                    # re-raise (pre-hardening behavior).
+                    cancelling = getattr(asyncio.current_task(), "cancelling",
+                                         None)
+                    if cancelling is None or cancelling():
+                        raise
+                    err = "Cancelled"
+                    await self.queue.done(req)
+                    await self._requeue_failed(req)
+                except Exception as e:  # reconcile errors → rate-limited requeue
+                    # TimeoutError with a deadline configured = OUR wait_for
+                    # fired (3.11+: asyncio.TimeoutError IS builtin
+                    # TimeoutError; a reconciler-raised timeout with no
+                    # deadline set stays a generic error).
+                    if (isinstance(e, asyncio.TimeoutError)
+                            and self.reconcile_timeout is not None):
+                        err = "ReconcileTimeout"
+                        self.timeouts_total += 1
+                        log.warning(
+                            "controller=%s req=%s reconcile exceeded %.1fs "
+                            "deadline; cancelled and requeued", self.name, req,
+                            self.reconcile_timeout)
+                    else:
+                        err = type(e).__name__
+                        log.warning("controller=%s req=%s reconcile error: %s",
+                                    self.name, req, e, exc_info=True)
+                    await self.queue.done(req)
+                    await self._requeue_failed(req)
                 else:
-                    err = type(e).__name__
-                    log.warning("controller=%s req=%s reconcile error: %s",
-                                self.name, req, e, exc_info=True)
-                await self.queue.done(req)
-                await self._requeue_failed(req)
-            else:
-                if not (result and result.preserve_failures):
-                    await self.queue.forget(req)
-                await self.queue.done(req)
-                if result and result.requeue_after is not None:
-                    await self.queue.add_after(req, result.requeue_after)
-                elif result and result.requeue:
-                    await self.queue.add_rate_limited(req)
-            finally:
-                if self._metrics_hook is not None:
-                    self._metrics_hook(self.name, time.monotonic() - start, err)
+                    if not (result and result.preserve_failures):
+                        await self.queue.forget(req)
+                    await self.queue.done(req)
+                    if result and result.requeue_after is not None:
+                        await self.queue.add_after(req, result.requeue_after)
+                    elif result and result.requeue:
+                        await self.queue.add_rate_limited(req)
+                finally:
+                    if self._metrics_hook is not None:
+                        self._metrics_hook(self.name,
+                                           time.monotonic() - start, err)
 
     async def run(self, client: Client) -> list[asyncio.Task]:
         tasks = [asyncio.create_task(self._pump(client, s), name=f"{self.name}/pump")

@@ -4,28 +4,51 @@ Analog of the record.EventRecorder the reference controllers use to surface
 insufficient-capacity / eviction / repair events (reference: lifecycle/events.go,
 terminator/events/, health/events.go). Dedupes by (involved UID, reason) with
 a count bump, like the apiserver's event aggregation.
+
+Two hardenings over the original:
+
+- Concurrent ``publish`` calls for the same (uid, reason) used to race the
+  get-then-create: both saw NotFound, the second create 409'd and the event
+  was silently dropped as "advisory". In-process calls now coalesce behind
+  a per-event-name lock, and a cross-process create/update conflict retries
+  as a count bump instead of dropping.
+- When a claimtrace span is active, the event carries the trace/span ids as
+  annotations (``trace_ids`` seam — injected by the assembly layer so this
+  module keeps pointing downward only).
 """
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import logging
+from typing import Callable, Optional
 
 from ..apis.core import Event, ObjectReference
 from ..apis.meta import Object, ObjectMeta
 from ..apis.serde import now
-from .client import Client, NotFoundError
+from .client import AlreadyExistsError, Client, ConflictError, NotFoundError
 
 NORMAL = "Normal"
 WARNING = "Warning"
+
+TRACE_ID_ANNOTATION = "tpu-provisioner.io/trace-id"
+SPAN_ID_ANNOTATION = "tpu-provisioner.io/span-id"
+
+_MAX_LOCKS = 1024
+_CONFLICT_RETRIES = 5
 
 log = logging.getLogger("events")
 
 
 class Recorder:
-    def __init__(self, client: Client, namespace: str = "default"):
+    def __init__(self, client: Client, namespace: str = "default",
+                 trace_ids: Optional[
+                     Callable[[], Optional[tuple[str, str]]]] = None):
         self.client = client
         self.namespace = namespace
+        self.trace_ids = trace_ids
+        self._locks: dict[str, asyncio.Lock] = {}
 
     async def publish(self, obj: Object, etype: str, reason: str, message: str) -> None:
         """Best-effort, like client-go's recorder: an event that cannot be
@@ -37,23 +60,61 @@ class Recorder:
             log.warning("dropping event %s/%s for %s: %s",
                         etype, reason, obj.metadata.name, e)
 
+    def _lock_for(self, name: str) -> asyncio.Lock:
+        if len(self._locks) > _MAX_LOCKS:
+            for k in [k for k, lk in self._locks.items() if not lk.locked()]:
+                self._locks.pop(k, None)
+        return self._locks.setdefault(name, asyncio.Lock())
+
+    def _annotations(self) -> dict[str, str]:
+        ids = self.trace_ids() if self.trace_ids is not None else None
+        if ids is None:
+            return {}
+        return {TRACE_ID_ANNOTATION: ids[0], SPAN_ID_ANNOTATION: ids[1]}
+
     async def _publish(self, obj: Object, etype: str, reason: str,
                        message: str) -> None:
         h = hashlib.sha1(f"{obj.metadata.uid}/{reason}".encode()).hexdigest()[:16]
         name = f"{obj.metadata.name}.{h}"
         ref = ObjectReference(kind=obj.KIND, namespace=obj.metadata.namespace,
                               name=obj.metadata.name, uid=obj.metadata.uid)
-        try:
-            ev = await self.client.get(Event, name, self.namespace)
-            ev.count += 1
-            ev.last_timestamp = now()
-            ev.message = message
-            await self.client.update(ev)
-        except NotFoundError:
-            await self.client.create(Event(
-                metadata=ObjectMeta(name=name, namespace=self.namespace),
-                involved_object=ref, reason=reason, message=message,
-                type=etype, count=1, last_timestamp=now()))
+        notes = self._annotations()
+        # In-process coalescing: the get-then-create below is not atomic,
+        # so concurrent publishes for one event name must serialize here —
+        # the loser of the old race 409'd and lost its count bump.
+        async with self._lock_for(name):
+            last: Optional[Exception] = None
+            for _ in range(_CONFLICT_RETRIES):
+                try:
+                    ev = await self.client.get(Event, name, self.namespace)
+                except NotFoundError:
+                    try:
+                        await self.client.create(Event(
+                            metadata=ObjectMeta(name=name,
+                                                namespace=self.namespace,
+                                                annotations=dict(notes)),
+                            involved_object=ref, reason=reason,
+                            message=message, type=etype, count=1,
+                            last_timestamp=now()))
+                        return
+                    except (AlreadyExistsError, ConflictError) as e:
+                        # Another replica created it between our get and
+                        # create (the 409 AlreadyExists of the old race) —
+                        # fall through to a count bump.
+                        last = e
+                        continue
+                ev.count += 1
+                ev.last_timestamp = now()
+                ev.message = message
+                if notes:
+                    ev.metadata.annotations.update(notes)
+                try:
+                    await self.client.update(ev)
+                    return
+                except ConflictError as e:  # stale resourceVersion; re-get
+                    last = e
+                    continue
+            raise last if last is not None else ConflictError(name)
 
 
 class NoopRecorder:

@@ -35,6 +35,13 @@ class RateLimitingQueue:
         self._queue: deque[Hashable] = deque()
         self._dirty: set[Hashable] = set()
         self._processing: set[Hashable] = set()
+        # Ready-queue residency stamps: set when an item lands in _queue,
+        # consumed by get() into _waits, handed to the worker via
+        # pop_wait() — the "queue-wait" phase of a claim's trace. Time
+        # parked in the delayed heap is deliberately NOT counted: backoff
+        # is the requeue-idle-gap phase, not queue congestion.
+        self._enqueued: dict[Hashable, float] = {}
+        self._waits: dict[Hashable, float] = {}
         self._failures: dict[Hashable, int] = {}
         self._delayed: list[tuple[float, int, Hashable]] = []
         self._seq = 0
@@ -57,6 +64,7 @@ class RateLimitingQueue:
         if item in self._processing:
             return  # will be re-queued on done()
         self._queue.append(item)
+        self._enqueued[item] = time.monotonic()
         self._cond.notify()
 
     async def add(self, item: Hashable) -> None:
@@ -165,16 +173,26 @@ class RateLimitingQueue:
                     item = self._queue.popleft()
                     self._dirty.discard(item)
                     self._processing.add(item)
+                    stamped = self._enqueued.pop(item, None)
+                    if stamped is not None:
+                        self._waits[item] = time.monotonic() - stamped
                     return item
                 if self._shutdown:
                     raise asyncio.CancelledError("workqueue shut down")
                 await self._cond.wait()
+
+    def pop_wait(self, item: Hashable) -> Optional[float]:
+        """Seconds ``item`` sat ready before the ``get()`` that returned it;
+        consumed exactly once (the worker pops it right after dequeue so
+        the dict stays bounded by in-flight items)."""
+        return self._waits.pop(item, None)
 
     async def done(self, item: Hashable) -> None:
         async with self._cond:
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._enqueued[item] = time.monotonic()
                 self._cond.notify()
 
     async def shutdown(self) -> None:

@@ -1,0 +1,21 @@
+"""PL012 true positives: span_begin with no finally-guaranteed span_end."""
+
+
+async def reconcile_bare(tracer, name):
+    token = tracer.span_begin(name, "reconcile")   # BAD: nothing closes it
+    result = await do_work(name)
+    tracer.span_end(token)                         # skipped if do_work raises
+    return result
+
+
+async def reconcile_except_only(tracer, name):
+    token = tracer.span_begin(name, "reconcile")   # BAD: except is not finally
+    try:
+        return await do_work(name)
+    except Exception:
+        tracer.span_end(token)
+        raise
+
+
+async def do_work(name):
+    return name

@@ -1,0 +1,32 @@
+"""PL012 true negatives: context-manager and try/finally span closure."""
+
+import contextlib
+
+
+async def reconcile_with_cm(tracer, name):
+    # the shape real code uses: tracer.span() closes in its own finally
+    with tracer.span(name, "reconcile"):
+        return await do_work(name)
+
+
+async def reconcile_manual_pair(tracer, name):
+    token = tracer.span_begin(name, "reconcile")
+    try:
+        return await do_work(name)
+    finally:
+        tracer.span_end(token)
+
+
+@contextlib.contextmanager
+def span(tracer, name):
+    # the tracer's own context-manager shape: begin BEFORE the try,
+    # end in the finally — function-scoped guarantee
+    token = tracer.span_begin(name, "reconcile")
+    try:
+        yield token
+    finally:
+        tracer.span_end(token)
+
+
+async def do_work(name):
+    return name

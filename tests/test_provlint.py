@@ -41,6 +41,7 @@ CASES = [
     ("PL009", "pl009", {ROLE_PACKAGE, ROLE_PROVIDERS}, 2),
     ("PL010", "pl010", {ROLE_TESTS}, 1),
     ("PL011", "pl011", {ROLE_TESTS}, 1),
+    ("PL012", "pl012", {ROLE_PACKAGE}, 2),
 ]
 
 

@@ -1,0 +1,359 @@
+"""claimtrace: tracer/store unit tests, critical-path analyzer semantics on
+synthetic traces, and the envtest round-trips — a provisioned claim's trace
+served over /traces/{claim}, trace ids stamped into log records and Event
+annotations, the reconcile-duration drain, and the restart re-anchor.
+
+The acceptance round-trip (ISSUE PR 9): the trace_id returned by
+``/traces/{claim}`` must match the ``trace_id`` attribute on captured log
+records and the ``tpu-provisioner.io/trace-id`` Event annotation.
+"""
+
+import asyncio
+import logging
+import os
+
+import pytest
+
+from gpu_provisioner_tpu import chaos
+from gpu_provisioner_tpu.apis.core import Event
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions, RestartableEnv
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.observability import (
+    Span, TraceEvent, Trace, TraceStore, Tracer, analyze_trace, current_ids,
+    install_log_record_factory, render_waterfall, wave_attribution,
+)
+from gpu_provisioner_tpu.observability.critical_path import (
+    IDLE, UNATTRIBUTED, classify,
+)
+from gpu_provisioner_tpu.runtime import InMemoryClient
+from gpu_provisioner_tpu.runtime.events import (
+    Recorder, SPAN_ID_ANNOTATION, TRACE_ID_ANNOTATION,
+)
+
+from .conftest import async_test
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+# --------------------------------------------------------------- tracer unit
+
+@async_test
+async def test_span_nesting_parenting_and_contextvar_restore():
+    tracer = Tracer(TraceStore())
+    assert current_ids() is None
+    outer = tracer.span_begin("c0", "outer")
+    tid = outer.trace.trace_id
+    assert current_ids() == (tid, outer.span.span_id)
+    inner = tracer.span_begin("c0", "inner")
+    assert inner.span.parent_id == outer.span.span_id
+    assert current_ids() == (tid, inner.span.span_id)
+    tracer.span_end(inner)
+    # closing the inner span restores the outer as current
+    assert current_ids() == (tid, outer.span.span_id)
+    tracer.span_end(outer)
+    assert current_ids() is None
+    # spans only enter the trace once closed, in close order
+    tr = tracer.store.get("c0")
+    assert [s.name for s in tr.spans] == ["inner", "outer"]
+    assert tr.spans[0].end >= tr.spans[0].start
+
+
+@async_test
+async def test_span_on_another_claim_does_not_parent_across_traces():
+    tracer = Tracer(TraceStore())
+    a = tracer.span_begin("a", "reconcile")
+    b = tracer.span_begin("b", "reconcile")
+    # different trace: no cross-claim parenting even though a is current
+    assert b.span.parent_id == ""
+    tracer.span_end(b)
+    tracer.span_end(a)
+
+
+@async_test
+async def test_record_span_never_touches_the_contextvar():
+    tracer = Tracer(TraceStore())
+    tracer.record_span("c0", "lro:create", 1.0, 2.5, reason="done")
+    assert current_ids() is None
+    s = tracer.store.get("c0").spans[0]
+    assert (s.start, s.end, s.attrs["reason"]) == (1.0, 2.5, "done")
+    # end clamped to start: a zero/negative interval never goes negative
+    tracer.record_span("c0", "node-wait", 5.0, 4.0)
+    assert tracer.store.get("c0").spans[1].duration == 0.0
+
+
+@async_test
+async def test_trace_span_bound_counts_drops():
+    store = TraceStore(max_spans=2)
+    tracer = Tracer(store)
+    for i in range(4):
+        tracer.record_span("c0", f"s{i}", float(i), i + 0.5)
+    tr = store.get("c0")
+    assert len(tr.spans) == 2 and tr.dropped_spans == 2
+    assert "2 spans dropped" in render_waterfall(tr)
+
+
+def test_store_eviction_is_fifo_and_counted():
+    store = TraceStore(max_traces=2)
+    for claim in ("a", "b", "c"):
+        store.get_or_create(claim)
+    assert len(store) == 2 and store.evicted_total == 1
+    assert store.get("a") is None and store.get("c") is not None
+    assert [t.claim for t in store.recent(1)] == ["c"]
+
+
+@async_test
+async def test_disabled_tracer_is_a_complete_noop():
+    store = TraceStore()
+    tracer = Tracer(store, enabled=False)
+    with tracer.span("c0", "reconcile") as token:
+        assert token is None
+        assert current_ids() is None
+    tracer.record_span("c0", "lro:create", 0.0, 1.0)
+    tracer.annotate("c0", "ready")
+    tracer.reanchor("c0")
+    assert len(store) == 0
+
+
+@async_test
+async def test_reanchor_replaces_the_trace_and_marks_the_discontinuity():
+    tracer = Tracer(TraceStore())
+    tracer.annotate("c0", "launched")
+    old_id = tracer.store.get("c0").trace_id
+    tracer.reanchor("c0", uid="u1")
+    tr = tracer.store.get("c0")
+    assert tr.trace_id != old_id
+    assert tr.attrs["reanchored"] is True and tr.attrs["uid"] == "u1"
+    assert [e.name for e in tr.events] == ["adopted-on-restart"]
+
+
+@async_test
+async def test_to_dict_offsets_are_relative_and_sorted():
+    tracer = Tracer(TraceStore())
+    tracer.record_span("c0", "late", 11.0, 12.0)
+    tracer.record_span("c0", "early", 10.0, 10.5)
+    tracer.annotate("c0", "ready")
+    doc = tracer.store.get("c0").to_dict()
+    assert [s["name"] for s in doc["spans"]] == ["early", "late"]
+    assert doc["spans"][0]["start"] == 0.0
+    assert doc["spans"][1] == {
+        "span_id": doc["spans"][1]["span_id"], "parent_id": "",
+        "name": "late", "start": 1.0, "duration": 1.0, "attrs": {}}
+    summary = tracer.store.get("c0").summary()
+    assert summary["spans"] == 2 and summary["events"] == 1
+
+
+def test_log_record_factory_stamps_inside_spans_and_is_idempotent(caplog):
+    install_log_record_factory()
+    wrapped = logging.getLogRecordFactory()
+    install_log_record_factory()   # second install must not re-wrap
+    assert logging.getLogRecordFactory() is wrapped
+    caplog.set_level(logging.INFO)
+    logger = logging.getLogger("claimtrace.unit")
+    tracer = Tracer(TraceStore())
+    token = tracer.span_begin("c0", "reconcile")
+    try:
+        logger.info("inside")
+    finally:
+        tracer.span_end(token)
+    logger.info("outside")
+    inside = next(r for r in caplog.records if r.getMessage() == "inside")
+    outside = next(r for r in caplog.records if r.getMessage() == "outside")
+    assert inside.trace_id == token.trace.trace_id
+    assert inside.span_id == token.span.span_id
+    assert not hasattr(outside, "trace_id")
+
+
+# ----------------------------------------------------- critical-path analyzer
+
+def _span(name, start, end):
+    return Span(span_id=name, parent_id="", name=name, start=start, end=end)
+
+
+def test_classify_span_names():
+    assert classify("reconcile:nodeclaim.lifecycle") == "reconcile"
+    assert classify("begin-create") == "cloud-call"
+    assert classify("lro:create") == "lro"
+    assert classify("adopt") is None
+
+
+def test_priority_overlap_unattributed_exec_and_idle_gap():
+    tr = Trace("c0")
+    tr.add_span(_span("reconcile:lifecycle", 0.0, 1.0))
+    tr.add_span(_span("status-write", 0.2, 0.4))     # outranks reconcile
+    tr.add_event(TraceEvent(name="ready", at=2.0))   # 1s nothing ran: idle
+    r = analyze_trace(tr, t0=0.0)
+    assert r["phases"]["status-write"] == pytest.approx(0.2)
+    assert r["phases"][UNATTRIBUTED] == pytest.approx(0.8)
+    assert r["phases"][IDLE] == pytest.approx(1.0)
+    # idle is NAMED (counts toward the gate); reconcile-exec is not
+    assert r["attributed_fraction"] == pytest.approx(1.2 / 2.0)
+
+
+def test_derived_node_wait_from_lro_end_to_registered():
+    tr = Trace("c0")
+    tr.add_span(_span("lro:create", 0.0, 1.0))
+    tr.add_event(TraceEvent(name="registered", at=1.5))
+    tr.add_event(TraceEvent(name="ready", at=1.5))
+    r = analyze_trace(tr, t0=0.0)
+    assert r["phases"]["lro"] == pytest.approx(1.0)
+    assert r["phases"]["node-wait"] == pytest.approx(0.5)
+    assert r["attributed_fraction"] == pytest.approx(1.0)
+
+
+def test_analyze_trace_returns_none_before_ready():
+    tr = Trace("c0")
+    tr.add_span(_span("reconcile:lifecycle", 0.0, 1.0))
+    assert analyze_trace(tr, t0=0.0) is None
+
+
+def test_wave_attribution_headline_is_the_critical_claim():
+    fast, slow = Trace("fast"), Trace("slow")
+    for tr, ready in ((fast, 1.0), (slow, 2.0)):
+        tr.add_span(_span("lro:create", 0.0, ready))
+        tr.add_event(TraceEvent(name="ready", at=ready))
+    r = wave_attribution([fast, slow], t0=0.0)
+    assert r["critical_claim"] == "slow" and r["claims"] == 2
+    assert r["wall"] == pytest.approx(2.0)
+    assert r["mean_phases"]["lro"] == pytest.approx(1.5)
+
+
+# ------------------------------------------------------------ event recorder
+
+@async_test
+async def test_event_annotations_carry_the_active_trace_ids():
+    client = InMemoryClient()
+    tracer = Tracer(TraceStore())
+    recorder = Recorder(client, trace_ids=current_ids)
+    nc = await client.create(make_nodeclaim("ev0"))
+    with tracer.span("ev0", "reconcile:test"):
+        await recorder.publish(nc, "Normal", "Probe", "hello")
+    await recorder.publish(nc, "Normal", "Unspanned", "bye")
+    evs = await client.list(Event, namespace="default")
+    by_reason = {e.reason: e for e in evs}
+    tr = tracer.store.get("ev0")
+    assert by_reason["Probe"].metadata.annotations[
+        TRACE_ID_ANNOTATION] == tr.trace_id
+    assert SPAN_ID_ANNOTATION in by_reason["Probe"].metadata.annotations
+    assert TRACE_ID_ANNOTATION not in by_reason["Unspanned"].metadata.annotations
+
+
+@async_test
+async def test_recorder_coalesces_concurrent_publishes():
+    """PR 9 regression: concurrent publishes for one (uid, reason) used to
+    race the get-then-create — the loser 409'd and its count bump was
+    silently dropped. Coalesced, N concurrent publishes must produce
+    exactly one Event with count == N."""
+    client = InMemoryClient()
+    recorder = Recorder(client)
+    nc = await client.create(make_nodeclaim("race0"))
+    n = 8
+    await asyncio.gather(*(recorder.publish(nc, "Normal", "Raced", f"m{i}")
+                           for i in range(n)))
+    evs = [e for e in await client.list(Event, namespace="default")
+           if e.reason == "Raced"]
+    assert len(evs) == 1, f"expected one aggregated Event, got {evs}"
+    assert evs[0].count == n, "a concurrent publish was silently dropped"
+
+
+# ------------------------------------------------------------ envtest round-trip
+
+@async_test
+async def test_traced_claim_round_trips_store_http_and_logs(caplog):
+    """The acceptance round-trip: provision a claim under the default-on
+    tracer, then match the trace_id across the TraceStore, the
+    /traces/{claim} HTTP surface, and captured log records."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from gpu_provisioner_tpu.controllers.metrics import (
+        RECONCILE_DURATION, drain_reconcile_durations, update_runtime_gauges,
+    )
+    from gpu_provisioner_tpu.operator.server import build_apps
+
+    caplog.set_level(logging.INFO)
+    async with Env(EnvtestOptions()) as env:
+        await env.client.create(make_nodeclaim("tr0"))
+        await env.wait_ready("tr0")
+
+        tr = env.trace_store.get("tr0")
+        assert tr is not None
+        phases = {s.name.split(":", 1)[0] for s in tr.spans}
+        assert {"queue-wait", "reconcile", "begin-create",
+                "status-write", "lro"} <= phases
+        marks = {e.name for e in tr.events}
+        assert {"launched", "registered", "ready"} <= marks
+        assert tr.attrs.get("uid"), "lifecycle never stamped the claim uid"
+
+        # the whole window decomposes: ≥95% gate at single-claim scale too
+        result = analyze_trace(tr)
+        assert result is not None
+        assert result["attributed_fraction"] >= 0.5, result
+
+        # HTTP surface over the same store
+        metrics_app, _health = build_apps(env.manager,
+                                          trace_store=env.trace_store)
+        async with TestClient(TestServer(metrics_app)) as mc:
+            listing = await (await mc.get("/traces")).json()
+            assert any(t["claim"] == "tr0" for t in listing["traces"])
+            r = await mc.get("/traces/tr0")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["trace_id"] == tr.trace_id
+            assert (await mc.get("/traces/nope")).status == 404
+            text = await (await mc.get("/traces/tr0?format=text")).text()
+            assert "tr0" in text and "@ready" in text
+
+        # log round-trip: a record emitted while this claim's span is
+        # active carries the exact trace_id /traces/{claim} serves
+        with env.tracer.span("tr0", "round-trip-probe"):
+            logging.getLogger("claimtrace.roundtrip").info("probe")
+        rec = next(r for r in caplog.records if r.getMessage() == "probe")
+        assert rec.trace_id == doc["trace_id"]
+
+        # reconcile-duration satellite: the wave buffered per-reconcile
+        # durations; the scrape-time drain flushes them into the histogram
+        # and empties the buffer (no await between the two calls, so no
+        # new reconcile can refill it in between)
+        sum0 = RECONCILE_DURATION.labels("nodeclaim.lifecycle")._sum.get()
+        update_runtime_gauges(env.manager)
+        assert RECONCILE_DURATION.labels(
+            "nodeclaim.lifecycle")._sum.get() > sum0
+        assert drain_reconcile_durations() == []
+
+
+@pytest.mark.chaos
+@async_test
+async def test_restart_reanchors_trace_and_surfaces_adoption_event(caplog):
+    """Crash after begin_create, restart: the adopted claim's trace in the
+    new incarnation is re-anchored (fresh trace_id, adopted-on-restart
+    marker) and the adoption — formerly a log line only — is an Event
+    carrying the re-anchored trace id."""
+    caplog.set_level(logging.INFO)
+    crashes = chaos.CrashPoints(at="after_pool_begin_create", seed=SEED)
+    renv = RestartableEnv(EnvtestOptions(crashes=crashes))
+    await renv.start()
+    try:
+        await renv.client.create(make_nodeclaim("ra0"))
+        await asyncio.wait_for(crashes.crashed.wait(), 15)
+
+        await renv.restart()
+        await renv.wait_ready("ra0", timeout=25)
+
+        tr = renv.env.trace_store.get("ra0")
+        assert tr is not None
+        assert tr.attrs.get("reanchored") is True
+        assert any(e.name == "adopted-on-restart" for e in tr.events)
+
+        evs = await renv.client.list(Event, namespace="default")
+        adoption = [e for e in evs
+                    if e.reason in ("LROAdopted", "CreateResumed")]
+        assert adoption, f"no adoption Event among {[e.reason for e in evs]}"
+        notes = adoption[0].metadata.annotations
+        assert notes.get(TRACE_ID_ANNOTATION) == tr.trace_id
+
+        # the production-path adoption log line (emitted inside the
+        # lifecycle reconcile span) is stamped too
+        adopted_logs = [r for r in caplog.records
+                        if "create already in progress" in r.getMessage()]
+        assert adopted_logs and all(hasattr(r, "trace_id")
+                                    for r in adopted_logs)
+    finally:
+        await renv.crash()
