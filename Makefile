@@ -64,6 +64,10 @@ recover: ## Crash-restart recovery soaks: crash-point matrix + fenced leader fai
 repair: ## Node-fault health soaks: fault-profile × workload matrix + repair regressions
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_health.py -q -m repair
 
+.PHONY: capacity
+capacity: ## Capacity soaks: zonal stockout survival, spot reclaim, crash-resume fallback walk
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_placement.py -q -m capacity
+
 .PHONY: e2etests-real
 e2etests-real: ## Same specs against a live cluster (suite_test.go:34-45 mode).
 	## Prereqs: operator deployed (make helm-install), KUBECONFIG pointing at

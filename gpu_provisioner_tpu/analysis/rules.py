@@ -13,6 +13,7 @@ import ast
 import re
 from pathlib import Path
 
+from ..errors import KNOWN_REASONS
 from .provlint import (
     ROLE_CHAOS, ROLE_CLOUDPROVIDER, ROLE_CONTROLLERS, ROLE_PACKAGE,
     ROLE_PROVIDERS, ROLE_RUNTIME, ROLE_TESTS,
@@ -503,6 +504,52 @@ def check_unclosed_span(ctx: RuleContext) -> list[tuple[int, str]]:
     return out
 
 
+# ---------------------------------------------- PL013 literal-error-reason
+
+def _reason_literals(expr: ast.AST) -> list[ast.Constant]:
+    """String Constants carrying a known CreateError reason value, descending
+    one level into literal tuples/sets/lists (``in ("A", "B")``)."""
+    elts = expr.elts if isinstance(expr, (ast.Tuple, ast.Set, ast.List)) \
+        else [expr]
+    return [e for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            and e.value in KNOWN_REASONS]
+
+
+def check_literal_error_reason(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            d = ctx.resolved(node.func) or dotted_name(node.func) or ""
+            if d.rsplit(".", 1)[-1] != "CreateError":
+                continue
+            # the reason slot: 2nd positional or reason= keyword
+            slots = node.args[1:2] + [kw.value for kw in node.keywords
+                                      if kw.arg == "reason"]
+            for s in slots:
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    out.append((s.lineno, (
+                        f"CreateError reason spelled as string literal "
+                        f"{s.value!r} — reasons come from the errors.py "
+                        f"enum (REASON_*); a literal drifts from "
+                        f"TERMINAL_REASONS and silently flips a terminal "
+                        f"fault into an infinite retry (or vice versa)")))
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if not any(isinstance(s, ast.Attribute) and s.attr == "reason"
+                       for s in sides):
+                continue
+            for s in sides:
+                for lit in _reason_literals(s):
+                    out.append((lit.lineno, (
+                        f".reason compared against string literal "
+                        f"{lit.value!r} — branch on the errors.py enum "
+                        f"(REASON_* / reason_is_terminal()) so the "
+                        f"terminal-vs-retryable classification has one "
+                        f"home")))
+    return out
+
+
 # ----------------------------------------------------------------- catalog
 
 RULES: list[Rule] = [
@@ -555,4 +602,9 @@ RULES: list[Rule] = [
          "claimtrace span_begin is closed via tracer.span() or a "
          "try/finally span_end — an open span leaks trace ids into every "
          "later log line on the task (PR 9 claimtrace)", check_unclosed_span),
+    Rule("PL013", "literal-error-reason", frozenset({ROLE_PACKAGE}),
+         "CreateError reasons and terminal-vs-retryable branching come from "
+         "the errors.py reason enum, never string literals at call sites "
+         "(PR 10 capacity placement: a drifted literal flips a terminal "
+         "fault into an infinite retry)", check_literal_error_reason),
 ]

@@ -63,6 +63,11 @@ TPU_SLICE_GROUP_LABEL = "tpu.kaito.sh/slice-group"     # multi-slice DCN group
 TPU_SLICE_INDEX_LABEL = "tpu.kaito.sh/slice-index"     # 0..num_slices-1
 TPU_NUM_SLICES_LABEL = "tpu.kaito.sh/num-slices"       # group size
 TPU_COORDINATOR_LABEL = "tpu.kaito.sh/coordinator"     # worker 0 of slice 0
+# Capacity tier the slice was actually placed on (reserved|on-demand|spot):
+# rides NodeClaim requirements → pool config labels → Node labels so the
+# placement engine can filter candidates and workloads can see what tier
+# they landed on. Values reuse the karpenter CAPACITY_TYPE_* constants.
+TPU_CAPACITY_TIER_LABEL = "tpu.kaito.sh/capacity-tier"
 
 # Taint applied by GKE to TPU nodes; tolerated by TPU workloads.
 TPU_TAINT = "google.com/tpu"

@@ -52,8 +52,14 @@ class SliceShape:
     def ici_dims(self) -> tuple[int, ...]:
         return tuple(int(d) for d in self.topology.split("x"))
 
-    def node_labels(self, slice_id: str = "") -> dict[str, str]:
-        """Labels every node of this slice carries (GKE-native + tpu.kaito.sh)."""
+    def node_labels(self, slice_id: str = "", zone: str = "",
+                    capacity_tier: str = "") -> dict[str, str]:
+        """Labels every node of this slice carries (GKE-native + tpu.kaito.sh).
+
+        ``zone``/``capacity_tier`` record the placement verdict: the zone the
+        slice actually landed in (``topology.kubernetes.io/zone`` — before
+        this, only ``provider_id`` carried it) and the capacity tier it was
+        placed on."""
         out = {
             wk.INSTANCE_TYPE_LABEL: self.name,
             wk.GKE_TPU_ACCELERATOR_LABEL: self.gke_accelerator,
@@ -66,6 +72,10 @@ class SliceShape:
         }
         if slice_id:
             out[wk.TPU_SLICE_ID_LABEL] = slice_id
+        if zone:
+            out[wk.ZONE_LABEL] = zone
+        if capacity_tier:
+            out[wk.TPU_CAPACITY_TIER_LABEL] = capacity_tier
         return out
 
     def per_host_capacity(self) -> dict[str, str]:
@@ -207,6 +217,75 @@ def resolve(reqs: Requirements, resources: Optional[dict[str, str]] = None) -> S
         s = smallest_fitting(gens[0], 1)
         if s is not None:
             return s
+
+    raise UnknownShapeError(
+        "requirements carry no resolvable instance-type, accelerator/topology, "
+        f"or google.com/tpu request (keys: {reqs.keys()})")
+
+
+def resolve_all(reqs: Requirements,
+                resources: Optional[dict[str, str]] = None) -> list[SliceShape]:
+    """Preference-ordered shape candidates for the placement fallback walk.
+
+    The first element is always exactly what :func:`resolve` returns (so the
+    happy path is unchanged); later elements are progressively-less-preferred
+    shapes that still satisfy the requirements — the order the placement
+    engine tries when a zone/generation is stocked out. Raises
+    :class:`UnknownShapeError` exactly when :func:`resolve` would.
+    """
+    out: list[SliceShape] = []
+    seen: set[str] = set()
+
+    def _add(s: Optional[SliceShape]) -> None:
+        if s is not None and s.name not in seen:
+            seen.add(s.name)
+            out.append(s)
+
+    itype_vals = reqs.get(wk.INSTANCE_TYPE_LABEL).values()
+    if itype_vals:
+        for v in itype_vals:
+            _add(lookup(v))
+        if not out:
+            raise UnknownShapeError(
+                f"instance-type values {itype_vals} match no TPU shape "
+                f"(known shapes look like 'tpu-v5e-8', 'v5p-32', 'v5litepod-8')")
+        return out
+
+    gen_req = reqs.get(wk.TPU_ACCELERATOR_LABEL)
+    gens = [g.lower() for g in gen_req.values()]
+    topo_vals = reqs.get(wk.TPU_TOPOLOGY_LABEL).values()
+    if gens and topo_vals:
+        for g in gens:
+            for t in topo_vals:
+                _add(lookup(f"{g}/{t}"))
+        if not out:
+            raise UnknownShapeError(
+                f"no shape for accelerator {gens} topology {topo_vals}")
+        return out
+    chips_req = reqs.get(wk.TPU_CHIPS_LABEL).values()
+    if gens and chips_req:
+        for g in gens:
+            _add(smallest_fitting(g, int(chips_req[0])))
+        if not out:
+            raise UnknownShapeError(f"no {gens[0]} shape with >= {chips_req[0]} chips")
+        return out
+
+    want = int(float((resources or {}).get(wk.TPU_RESOURCE_NAME, 0)))
+    if want > 0:
+        if gens:
+            for g in gens:
+                _add(smallest_fitting(g, want))
+        else:
+            _add(smallest_fitting(None, want))
+        if not out:
+            raise UnknownShapeError(f"no shape with >= {want} chips")
+        return out
+
+    if gens:
+        for g in gens:
+            _add(smallest_fitting(g, 1))
+        if out:
+            return out
 
     raise UnknownShapeError(
         "requirements carry no resolvable instance-type, accelerator/topology, "

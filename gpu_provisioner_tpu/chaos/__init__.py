@@ -8,16 +8,19 @@ from .client import ChaosClient, ChaosClientError, transient_kube
 from .crash import CRASH_POINTS, CrashPoints, SimulatedCrash
 from .nodefaults import (
     ACCELERATOR_HEALTHY, FAULT_KINDS, MAINTENANCE_SCHEDULED,
-    NODE_FAULT_PROFILES, NodeFault, NodeFaultInjector, node_fault_profile,
+    NODE_FAULT_PROFILES, NodeFault, NodeFaultInjector, SPOT_PREEMPTED,
+    node_fault_profile,
 )
 from .policy import (
-    ChaosPolicy, FaultRule, PROFILES, profile, stockout, transient,
+    ChaosPolicy, FaultRule, PROFILES, ZoneWindow, profile, stockout,
+    transient,
 )
 
 __all__ = [
     "ACCELERATOR_HEALTHY", "CRASH_POINTS", "ChaosClient", "ChaosClientError",
     "ChaosPolicy", "CrashPoints", "FAULT_KINDS", "FaultRule",
     "MAINTENANCE_SCHEDULED", "NODE_FAULT_PROFILES", "NodeFault",
-    "NodeFaultInjector", "PROFILES", "SimulatedCrash", "node_fault_profile",
-    "profile", "stockout", "transient", "transient_kube",
+    "NodeFaultInjector", "PROFILES", "SPOT_PREEMPTED", "SimulatedCrash",
+    "ZoneWindow", "node_fault_profile", "profile", "stockout", "transient",
+    "transient_kube",
 ]

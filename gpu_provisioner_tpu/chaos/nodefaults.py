@@ -45,6 +45,11 @@ log = logging.getLogger("chaos.nodefaults")
 # Condition types the repair policies key off (cloudprovider/tpu.py).
 ACCELERATOR_HEALTHY = "AcceleratorHealthy"
 MAINTENANCE_SCHEDULED = "MaintenanceScheduled"
+# Stamped by the fake cloud's spot-reclaim sweep (not this injector — the
+# preemption notice comes from the cloud, not a sick kubelet); repair treats
+# it as a short-toleration replace-now fault and the placement engine counts
+# it into the spot-zone demotion hysteresis.
+SPOT_PREEMPTED = "SpotPreempted"
 
 FAULT_KINDS = ("flap", "degrade", "silent", "maintenance")
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import time
 from collections import defaultdict
 from dataclasses import dataclass
 from fnmatch import fnmatch
@@ -51,6 +52,23 @@ class FaultRule:
     until: Optional[int] = None
 
 
+@dataclass
+class ZoneWindow:
+    """A scripted per-zone dry window, consumed by the fake cloud's capacity
+    model: while a zone matching ``match`` (fnmatch) is inside
+    ``[start, start + duration)`` on its own clock, every ``begin_create``
+    into it verdicts RESOURCE_EXHAUSTED regardless of inventory.
+
+    The clock is anchored at the zone's FIRST CONSULT (the ``nodefaults.py``
+    first-observation idiom): the window is deterministic relative to when
+    traffic first reaches the zone, not wall-clock test startup, so a soak's
+    probe counts are reproducible whatever the harness warm-up costs."""
+
+    match: str
+    start: float = 0.0
+    duration: float = 1.0
+
+
 def transient(code: int = 503, message: str = "chaos: transient") -> Callable[[], Exception]:
     return lambda: APIError(message, code=code)
 
@@ -75,10 +93,22 @@ class ChaosPolicy:
     """
 
     def __init__(self, seed: int = 0, rules: Optional[list[FaultRule]] = None,
-                 partial: Optional[dict[str, float]] = None):
+                 partial: Optional[dict[str, float]] = None,
+                 zone_windows: Optional[list[ZoneWindow]] = None,
+                 spot: Optional[dict[str, float]] = None):
         self.seed = seed
         self.rules = list(rules or [])
         self.partial = dict(partial or {})
+        # capacity-fault layer (consumed by the fake cloud's capacity model):
+        # scripted per-zone dry windows, and the spot-preemption spec
+        # {"rate", "after", "window"} — rate is the stable per-pool victim
+        # probability, after the minimum pool age before the notice, window
+        # bounds the reclaim wave (anchored at first consult) so replacement
+        # pools created once it closes survive and soaks converge.
+        self.zone_windows = list(zone_windows or [])
+        self.spot = dict(spot or {})
+        self._zone_first_seen: dict[str, float] = {}
+        self._spot_anchor: Optional[float] = None
         self._site_calls: dict[str, int] = defaultdict(int)
         self._key_calls: dict[tuple, int] = defaultdict(int)
         # observability for soak assertions: what actually fired
@@ -134,6 +164,43 @@ class ChaosPolicy:
             self.injected[f"{mode}:{key}"] += 1
         return hit
 
+    # --------------------------------------------------- capacity faults
+    def zone_dry(self, zone: str) -> bool:
+        """True while ``zone`` sits inside a scripted dry window on its own
+        first-consult-anchored clock. Counted under ``stockout:<zone>`` so
+        soaks can assert how often the dry verdict actually fired."""
+        now = time.monotonic()
+        first = self._zone_first_seen.setdefault(zone, now)
+        elapsed = now - first
+        for w in self.zone_windows:
+            if not fnmatch(zone, w.match):
+                continue
+            if w.start <= elapsed < w.start + w.duration:
+                self.injected[f"stockout:{zone}"] += 1
+                return True
+        return False
+
+    def spot_preempt(self, pool: str, age: float) -> bool:
+        """Deterministic spot-preemption verdict for a RUNNING spot pool of
+        ``age`` seconds. The draw is stable per pool name (a spared pool
+        stays spared); the wave window is anchored at the first consult so
+        replacements created after it closes are never preempted."""
+        rate = self.spot.get("rate", 0.0)
+        if rate <= 0:
+            return False
+        now = time.monotonic()
+        if self._spot_anchor is None:
+            self._spot_anchor = now
+        window = self.spot.get("window")
+        if window is not None and now - self._spot_anchor >= window:
+            return False
+        if age < self.spot.get("after", 0.0):
+            return False
+        if rate >= 1.0 or self._draw("spot", pool) < rate:
+            self.injected[f"spot_preempt:{pool}"] += 1
+            return True
+        return False
+
     def injected_total(self, prefix: str = "") -> int:
         return sum(v for k, v in self.injected.items() if k.startswith(prefix))
 
@@ -175,14 +242,46 @@ def _flaky_cloud(seed: int) -> ChaosPolicy:
 
 @_register("stockout")
 def _stockout(seed: int) -> ChaosPolicy:
-    """RESOURCE_EXHAUSTED bursts: the first creates hit a stockout (terminal
-    for those claims — deleted, KAITO would re-shape), later creates go
-    through. Mixed terminal/success convergence."""
+    """Deterministic full stockout: EVERY zone is dry for its first second
+    (capacity-model dry window, not a probabilistic call-count burst — that
+    shape survives as ``stockout-flaky``). Claims whose placement walk runs
+    inside the window terminate (deleted, KAITO would re-shape); creates
+    after it go through. Composes with the fake cloud's zone inventories:
+    the window dries a zone regardless of chips remaining."""
+    return ChaosPolicy(seed, zone_windows=[ZoneWindow(match="*", duration=1.0)])
+
+
+@_register("stockout-flaky")
+def _stockout_flaky(seed: int) -> ChaosPolicy:
+    """RESOURCE_EXHAUSTED bursts (the pre-capacity-model ``stockout``
+    shape): the first creates hit a stockout (terminal for those claims —
+    deleted, KAITO would re-shape), later creates go through, with 10%
+    transient noise on top. Mixed terminal/success convergence."""
     return ChaosPolicy(seed, rules=[
         FaultRule(match="nodepools.begin_create", rate=1.0, until=2,
                   error=stockout()),
         FaultRule(match="nodepools.*", rate=0.1, error=transient(503)),
     ])
+
+
+@_register("zonal_stockout")
+def _zonal_stockout(seed: int) -> ChaosPolicy:
+    """One zone of the fleet dries up and stays dry (``*-b`` — in the
+    canonical three-zone envtest layout that is exactly one of three) while
+    its siblings keep capacity: the placement walk must route every claim
+    around the dry zone, and the stockout memo must hold probes of it to
+    one per TTL window. No noise rules — probe counts are the assertion."""
+    return ChaosPolicy(seed, zone_windows=[
+        ZoneWindow(match="*-b", start=0.0, duration=600.0)])
+
+
+@_register("spot_reclaim")
+def _spot_reclaim(seed: int) -> ChaosPolicy:
+    """The cloud preempts every spot pool older than 0.2s during a 1.5s
+    reclaim wave: nodes get the SpotPreempted notice, then the pool is
+    reclaim-deleted after the grace. Repair must replace the slices within
+    budget; replacements created after the wave closes survive."""
+    return ChaosPolicy(seed, spot={"rate": 1.0, "after": 0.2, "window": 1.5})
 
 
 @_register("partial-provision")

@@ -31,6 +31,11 @@ PROVIDER_NAME = "gcp"  # reference names itself "azure" (cloudprovider.go:49)
 # (reference: cloudprovider.go:103-116).
 REPAIR_TOLERATION_SECONDS = 10 * 60
 
+# Spot preemption is a done deal the moment the cloud stamps the notice —
+# tolerating it buys nothing (the capacity is being reclaimed regardless),
+# so the policy uses a much shorter fuse than hardware-fault repair.
+SPOT_REPAIR_TOLERATION_SECONDS = 30.0
+
 
 class TPUCloudProvider:
     def __init__(self, instances: InstanceProvider,
@@ -78,6 +83,13 @@ class TPUCloudProvider:
             # maintenance WAVE (many nodes at once) is held back by the
             # health controller's unhealthy-fraction breaker + RepairBudget.
             RepairPolicy("MaintenanceScheduled", "True", self.repair_toleration),
+            # TPU extension: spot capacity reclaimed by the cloud. The grace
+            # window is short by design — the node WILL disappear; repair
+            # exists to re-place the slice (the placement engine's fallback
+            # walk picks the zone), not to wait the fault out.
+            RepairPolicy("SpotPreempted", "True",
+                         min(self.repair_toleration,
+                             SPOT_REPAIR_TOLERATION_SECONDS)),
         ]
 
     def get_supported_node_classes(self) -> list[type]:

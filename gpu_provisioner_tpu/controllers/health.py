@@ -331,6 +331,15 @@ class NodeHealthController:
                 reason=diag.reason)
             self._repairs[req.name] = rep
             REPAIR_STATS["started"] += 1
+            if diag.reason == "SpotPreempted":
+                # Feed the placement engine's spot-zone demotion hysteresis:
+                # enough preemptions inside the window and the engine sinks
+                # this zone to the back of the spot candidate order, so the
+                # replacement claim lands somewhere calmer. Lazy import —
+                # controllers never depend on providers at module scope.
+                from ..providers.placement import note_spot_preemption
+                note_spot_preemption(
+                    node.metadata.labels.get(wk.ZONE_LABEL, ""))
             log.info("repairing node %s (%s): %s", req.name, diag.reason,
                      diag.detail)
             if self.recorder is not None:

@@ -37,7 +37,7 @@ from ..apis.meta import OwnerReference
 from ..apis.serde import fmt_time, now
 from ..errors import (
     CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
-    NodeClassNotReadyError,
+    NodeClassNotReadyError, REASON_CREATE_IN_PROGRESS, reason_is_terminal,
 )
 from ..providers.operations import loop_now
 from ..runtime import NotFoundError, Request, Result
@@ -230,7 +230,22 @@ class NodeClaimLifecycleController:
                 return None
             except CreateError as e:
                 cs.set_false(LAUNCHED, e.reason, str(e))
-                if e.reason == "CreateInProgress":
+                if reason_is_terminal(e.reason):
+                    # Terminal verdict from the create path itself (e.g.
+                    # Stockout after the placement walk exhausted every
+                    # candidate): retrying cannot succeed, so take the same
+                    # exit as InsufficientCapacityError above — Event, flush,
+                    # delete the claim, let KAITO re-shape if it wants.
+                    log.warning("nodeclaim %s launch terminal failure (%s): %s",
+                                nc.metadata.name, e.reason, e)
+                    await self._publish(nc, "Warning", e.reason, str(e))
+                    await self._flush_status(nc)
+                    try:
+                        await self.client.delete(NodeClaim, nc.metadata.name)
+                    except NotFoundError:
+                        pass
+                    return None
+                if e.reason == REASON_CREATE_IN_PROGRESS:
                     # Non-blocking provisioning: the operation tracker owns
                     # the wait — this is progress, not failure. Requeue at
                     # the in-progress cadence (no failure counter accrues,

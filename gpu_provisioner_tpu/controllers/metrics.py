@@ -216,6 +216,32 @@ REPAIR_DURATION = _get_or_create(
     "Repair duration from commit (cordon) to NodeClaim force-delete.", [],
     buckets=(0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800))
 
+# ------------------------------------------------------ capacity placement
+# True Counters (hence the _total names — counters only go up) fed by DELTA
+# from the placement engine's module registries at scrape time: the
+# providers layer never imports prometheus, so each scrape increments by
+# what accumulated since its last-seen snapshot.
+
+STOCKOUTS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_stockouts_total",
+    "Zonal stockouts observed by the placement walk: terminal "
+    "RESOURCE_EXHAUSTED from begin_create, plus memo-suppressed probes of "
+    "a known-dry zone.", ["zone"])
+
+FALLBACK_PLACEMENTS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_fallback_placements_total",
+    "Claims placed on a candidate other than their first preference, by "
+    "preferred and actual zone.", ["from_zone", "to_zone"])
+
+SPOT_PREEMPTIONS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_spot_preemptions_total",
+    "Spot slices reclaimed by the cloud (repairs committed for a "
+    "SpotPreempted condition), by zone.", ["zone"])
+
+_stockouts_seen: dict[str, int] = {}
+_fallbacks_seen: dict[tuple[str, str], int] = {}
+_spot_preemptions_seen: dict[str, int] = {}
+
 _CACHE_GAUGES = (
     ("hits", INSTANCE_CACHE_HITS),
     ("misses", INSTANCE_CACHE_MISSES),
@@ -265,6 +291,22 @@ def update_runtime_gauges(manager) -> None:
     REPAIR_FLAP_DETECTIONS.set(_health.REPAIR_STATS["flap_detections"])
     for seconds in _health.drain_repair_durations():
         REPAIR_DURATION.observe(seconds)
+    from ..providers import placement as _placement
+    for zone, n in list(_placement.STOCKOUTS.items()):
+        delta = n - _stockouts_seen.get(zone, 0)
+        if delta > 0:
+            STOCKOUTS_TOTAL.labels(zone).inc(delta)
+            _stockouts_seen[zone] = n
+    for (src, dst), n in list(_placement.FALLBACKS.items()):
+        delta = n - _fallbacks_seen.get((src, dst), 0)
+        if delta > 0:
+            FALLBACK_PLACEMENTS_TOTAL.labels(src, dst).inc(delta)
+            _fallbacks_seen[(src, dst)] = n
+    for zone, n in list(_placement.SPOT_PREEMPTIONS.items()):
+        delta = n - _spot_preemptions_seen.get(zone, 0)
+        if delta > 0:
+            SPOT_PREEMPTIONS_TOTAL.labels(zone).inc(delta)
+            _spot_preemptions_seen[zone] = n
     # Drop series for breakers whose client closed — a stale "open" reading
     # would keep an alert firing for an endpoint nothing gates on anymore.
     for name in _exported_breakers - set(BREAKERS):

@@ -50,6 +50,20 @@ class EnvtestOptions:
     blocking_create: bool = False
     # Tracker tick cadence; defaults to node_wait_interval.
     operation_poll_interval: Optional[float] = None
+    # Capacity-aware placement (providers/placement.py + fake/cloud.py):
+    # zone name -> {generation -> chip inventory}. Order is preference
+    # order — it seeds both the fake cloud's per-zone pools and the
+    # provider's candidate walk. None keeps the legacy single-zone world
+    # (infinite capacity, no fallback).
+    zones: Optional[dict] = None
+    # How long the fake cloud lets a preempted spot slice linger between
+    # the SpotPreempted notice and the reclaim delete (GKE's ~grace).
+    spot_reclaim_grace: float = 0.25
+    # Stockout-memo TTL (envtest timescale; production default is 5s) and
+    # the spot-zone demotion hysteresis knobs.
+    stockout_memo_ttl: float = 0.5
+    spot_demote_threshold: int = 3
+    spot_demote_window: float = 10.0
     # Read-through instance cache (providers/cache.py), scaled to envtest's
     # time compression (real default is 1s). 0 disables positive caching
     # but keeps singleflight coalescing.
@@ -154,6 +168,8 @@ def _make_cloud(opts: EnvtestOptions, client: InMemoryClient) -> FakeCloud:
         node_join_delay=opts.node_join_delay,
         node_ready_delay=opts.node_ready_delay,
         qr_step_latency=opts.qr_step_latency,
+        zones=opts.zones,
+        spot_reclaim_grace=opts.spot_reclaim_grace,
         chaos=opts.chaos)
 
 
@@ -217,7 +233,11 @@ class Env:
                 node_wait_attempts=self.opts.node_wait_attempts,
                 cache_ttl=self.opts.instance_cache_ttl,
                 qr_cache_ttl=0.0,
-                cache_negative_ttl=self.opts.instance_cache_negative_ttl),
+                cache_negative_ttl=self.opts.instance_cache_negative_ttl,
+                zones=tuple(self.opts.zones) if self.opts.zones else (),
+                stockout_memo_ttl=self.opts.stockout_memo_ttl,
+                spot_demote_threshold=self.opts.spot_demote_threshold,
+                spot_demote_window=self.opts.spot_demote_window),
             queued=self.cloud.queuedresources,
             crashes=self.opts.crashes, fence=fence, tracer=self.tracer)
         self.tracker = None

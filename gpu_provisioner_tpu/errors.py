@@ -12,6 +12,60 @@ from __future__ import annotations
 
 from typing import Optional
 
+# ------------------------------------------------------------ reason enum
+# The single home for every ``CreateError.reason`` value (and the tracker's
+# TrackedOperation.reason strings that feed them). Terminal-vs-retryable
+# classification comes from THIS table — never from string literals at call
+# sites (provlint PL013): a reason spelled inline drifts from the
+# classification below and silently flips a terminal fault into an
+# infinite-retry loop (or vice versa).
+
+REASON_LAUNCH_FAILED = "LaunchFailed"
+REASON_CREATE_IN_PROGRESS = "CreateInProgress"
+REASON_INVALID_NAME = "InvalidName"
+REASON_UNRESOLVABLE_SHAPE = "UnresolvableShape"
+REASON_INVALID_STORAGE_REQUEST = "InvalidStorageRequest"
+REASON_QUEUED_PROVISIONING = "QueuedProvisioning"
+REASON_DEGRADED_POOL = "DegradedPool"
+REASON_NODES_NOT_READY = "NodesNotReady"
+REASON_SUPERSEDED = "Superseded"
+REASON_DISCARDED = "Discarded"
+REASON_DELETE_TIMEOUT = "DeleteTimeout"
+REASON_DELETED = "Deleted"
+REASON_CREATED = "Created"
+# Capacity exhausted across EVERY placement candidate (zone × generation ×
+# tier): the claim can never launch as specified — terminal, like
+# InsufficientCapacityError, but carrying the walk's verdict as a reason.
+REASON_STOCKOUT = "Stockout"
+
+# Reasons that mean "this claim can never converge as specified": the
+# lifecycle launch reconciler deletes the NodeClaim (KAITO retries with a
+# different shape) instead of requeueing. Invalid-input reasons
+# (InvalidName/UnresolvableShape/InvalidStorageRequest) stay on the
+# retry-then-liveness path — they surface a Launched=False condition the
+# operator can read, and the launch deadline reaps them (the taxonomy table
+# in docs/FAILURE_MODES.md).
+TERMINAL_REASONS = frozenset({
+    REASON_STOCKOUT,
+})
+
+
+def reason_is_terminal(reason: str) -> bool:
+    """True when a CreateError with this reason should terminate the claim
+    rather than requeue it."""
+    return reason in TERMINAL_REASONS
+
+
+# The full vocabulary, for tooling: provlint PL013 flags any of these values
+# spelled as a literal in a CreateError() call or a ``.reason`` comparison.
+KNOWN_REASONS = frozenset({
+    REASON_LAUNCH_FAILED, REASON_CREATE_IN_PROGRESS, REASON_INVALID_NAME,
+    REASON_UNRESOLVABLE_SHAPE, REASON_INVALID_STORAGE_REQUEST,
+    REASON_QUEUED_PROVISIONING, REASON_DEGRADED_POOL, REASON_NODES_NOT_READY,
+    REASON_SUPERSEDED, REASON_DISCARDED, REASON_DELETE_TIMEOUT,
+    REASON_DELETED, REASON_CREATED, REASON_STOCKOUT,
+})
+
 
 class CloudProviderError(Exception):
     pass
@@ -38,7 +92,7 @@ class NodeClassNotReadyError(CloudProviderError):
 class CreateError(CloudProviderError):
     """Create failed in a way that should surface as a Launched=False reason."""
 
-    def __init__(self, message: str, reason: str = "LaunchFailed"):
+    def __init__(self, message: str, reason: str = REASON_LAUNCH_FAILED):
         super().__init__(message)
         self.reason = reason
 

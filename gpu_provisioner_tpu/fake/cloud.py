@@ -32,7 +32,7 @@ from ..providers.gcp import (
 )
 from ..providers.instance import instance_name, provider_id
 from ..runtime.client import Client, NotFoundError
-from .builders import make_node
+from .builders import make_node, set_node_condition
 
 
 class TimedOperation:
@@ -112,6 +112,14 @@ class FakeNodePoolsAPI(_FaultInjector):
         super().__init__()
         self.cloud = cloud
         self.pools: dict[str, NodePool] = {}
+        # Capacity ledger: pool name -> (zone, generation, chips) reserved
+        # against the cloud's per-zone inventory at begin_create admission;
+        # released when the pool's delete (or create-error) settles.
+        self._reserved: dict[str, tuple[str, str, int]] = {}
+        # Spot-reclaim bookkeeping: creation stamps for pool ages, and the
+        # pools already served a preemption notice (one notice per pool).
+        self._created_at: dict[str, float] = {}
+        self._preempted: set[str] = set()
         # Server-side LRO ledger: name -> (deadline, kind, pool-at-issue).
         # Real clouds keep executing an issued operation whether or not the
         # client that issued it is still alive; the old fake only advanced
@@ -137,14 +145,83 @@ class FakeNodePoolsAPI(_FaultInjector):
         elif kind == "create-error":
             pool.status = NP_ERROR
             pool.status_message = "chaos: create operation failed"
+            self._release(name)  # a failed create holds no capacity
         elif kind == "delete":
             self.pools.pop(name, None)
+            self._release(name)
+            self._preempted.discard(name)
             if not self.cloud.leave_orphan_nodes:
                 await self.cloud.remove_nodes(name)
 
     async def _settle_all(self) -> None:
         for name in list(self._pending):
             await self._settle(name)
+        await self._sweep_spot()
+
+    # ----------------------------------------------------- capacity model
+    def _pool_zone(self, pool: NodePool) -> str:
+        return pool.config.labels.get(wk.ZONE_LABEL, self.cloud.zone)
+
+    def _check_capacity(self, pool: NodePool, zone: str) -> None:
+        """Admission-time capacity verdict (real clouds answer
+        RESOURCE_EXHAUSTED synchronously at node-pool create): a scripted
+        chaos dry window dries the zone outright; otherwise the pool's chip
+        bill is reserved against the zone × generation inventory. Without a
+        ``zones=`` inventory the cloud keeps its legacy unlimited capacity
+        (the dry window still applies)."""
+        if self.chaos is not None and self.chaos.zone_dry(zone):
+            raise APIError(
+                f"chaos: zone {zone} out of TPU capacity", code=429)
+        inv = self.cloud.inventory
+        if not inv:
+            return
+        gen = pool.config.labels.get(wk.TPU_ACCELERATOR_LABEL, "")
+        chips = int(pool.config.labels.get(wk.TPU_CHIPS_LABEL, "0") or 0)
+        zone_inv = inv.get(zone)
+        if zone_inv is None:
+            raise APIError(f"zone {zone} has no TPU capacity pool", code=429)
+        have = zone_inv.get(gen, 0)
+        if have < chips:
+            raise APIError(
+                f"zone {zone} out of {gen} capacity "
+                f"({have} chips left, {chips} needed)", code=429)
+        zone_inv[gen] = have - chips
+        self._reserved[pool.name] = (zone, gen, chips)
+
+    def _release(self, name: str) -> None:
+        """Return a pool's reserved chips to its zone pool. Pop-guarded so
+        the create-error and delete settle paths can both call it without
+        double-crediting."""
+        res = self._reserved.pop(name, None)
+        if res is None:
+            return
+        zone, gen, chips = res
+        zone_inv = self.cloud.inventory.get(zone)
+        if zone_inv is not None:
+            zone_inv[gen] = zone_inv.get(gen, 0) + chips
+
+    async def _sweep_spot(self) -> None:
+        """Spot preemption, driven from API entry (no background task — the
+        envtest task-leak gate stays meaningful): a RUNNING spot pool the
+        chaos policy verdicts preempted gets its nodes stamped with a
+        SpotPreempted=True condition (the preemption notice) and a reclaim
+        delete scheduled after ``cloud.spot_reclaim_grace`` — repair
+        usually wins the race by replacing the claim first, but the chips
+        come back either way when the reclaim settles."""
+        if self.chaos is None:
+            return
+        now = time.monotonic()
+        for name, pool in list(self.pools.items()):
+            if (not pool.config.spot or pool.status != NP_RUNNING
+                    or name in self._preempted or name in self._pending):
+                continue
+            age = now - self._created_at.get(name, now)
+            if not self.chaos.spot_preempt(name, age):
+                continue
+            self._preempted.add(name)
+            await self.cloud.stamp_spot_preempted(name)
+            self._pending[name] = (
+                now + self.cloud.spot_reclaim_grace, "delete", pool)
 
     def _count_op_poll(self) -> None:
         # one client-side done() check == one operations.get round-trip
@@ -162,9 +239,19 @@ class FakeNodePoolsAPI(_FaultInjector):
             # replace-never-duplicate contract.
             raise APIError(f"nodepool {pool.name} already exists "
                            f"({existing.status})", code=409)
+        # Capacity admission. The zone-keyed probe counter is what the
+        # stockout soaks assert on (≤ 1 probe of a dry zone per memo TTL);
+        # conflicts above are adoption, not placement probes, so they are
+        # deliberately not counted here.
+        zone = self._pool_zone(pool)
+        self.calls[f"begin_create:{zone}"] += 1
+        self._release(pool.name)  # replacing an ERROR carcass frees its bill
+        self._check_capacity(pool, zone)
         stored = NodePool.from_dict(pool.to_dict())
         stored.status = NP_PROVISIONING
         self.pools[pool.name] = stored
+        self._created_at[pool.name] = time.monotonic()
+        self._preempted.discard(pool.name)  # same-name replacement is fresh
 
         # Chaos partial mode: the LRO "completes" but result() raises and the
         # pool is a dead ERROR carcass with no nodes — the caller's retry
@@ -287,7 +374,9 @@ class FakeCloud:
                  node_join_delay: float = 0.0, node_ready_delay: float = 0.0,
                  qr_step_latency: float = 0.02,
                  leave_orphan_nodes: bool = False,
-                 chaos=None):
+                 chaos=None,
+                 zones: Optional[dict[str, dict[str, int]]] = None,
+                 spot_reclaim_grace: float = 0.25):
         self.kube = kube
         self.project, self.zone, self.cluster = project, zone, cluster
         self.create_latency = create_latency
@@ -296,6 +385,18 @@ class FakeCloud:
         self.node_ready_delay = node_ready_delay
         self.qr_step_latency = qr_step_latency
         self.leave_orphan_nodes = leave_orphan_nodes
+        # Per-zone × per-generation chip inventory, e.g.
+        # ``zones={"us-central2-a": {"v5e": 64}, "us-central2-b": {"v5e": 0}}``
+        # — begin_create reserves a pool's chip bill against its zone (the
+        # zone read from the pool's topology label, falling back to the
+        # cloud's home zone) and verdicts RESOURCE_EXHAUSTED when the pool
+        # is short; deletes return the chips. ``None``/empty keeps the
+        # legacy unlimited-capacity behavior.
+        self.inventory: dict[str, dict[str, int]] = {
+            z: dict(gens) for z, gens in (zones or {}).items()}
+        # Notice window between the SpotPreempted condition landing on a
+        # pool's nodes and the cloud reclaim-deleting the pool.
+        self.spot_reclaim_grace = spot_reclaim_grace
         self.nodepools = FakeNodePoolsAPI(self)
         self.queuedresources = FakeQueuedResourcesAPI(self)
         self._join_tasks: list[asyncio.Task] = []
@@ -317,13 +418,17 @@ class FakeCloud:
         shape = catalog_lookup(pool.config.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
         capacity = (shape.per_host_capacity() if shape
                     else {wk.TPU_RESOURCE_NAME: "1", "cpu": "96", "memory": "448Gi"})
+        # providerIDs carry the zone the pool actually landed in (the
+        # placement verdict rides the pool's topology label; single-zone
+        # pools fall back to the cloud's home zone)
+        zone = self.nodepools._pool_zone(pool)
         for worker in range(pool.initial_node_count):
             name = instance_name(self.cluster, pool.name, worker)
             labels = dict(pool.config.labels)
             labels[wk.GKE_NODEPOOL_LABEL] = pool.name
             labels[wk.TPU_WORKER_INDEX_LABEL] = str(worker)
             labels[wk.HOSTNAME_LABEL] = name
-            node = make_node(name, provider_id=provider_id(self.project, self.zone, name),
+            node = make_node(name, provider_id=provider_id(self.project, zone, name),
                              pool=pool.name, ready=self.node_ready_delay <= 0,
                              labels=labels)
             node.status.capacity = dict(capacity)
@@ -357,6 +462,21 @@ class FakeCloud:
                 c.status = "True"
                 c.reason = "KubeletReady"
         await self.kube.update_status(fresh)
+
+    async def stamp_spot_preempted(self, pool_name: str) -> None:
+        """Deliver the preemption notice: SpotPreempted=True on every node
+        of the pool, the way GKE surfaces the ACPI shutdown notice as a node
+        condition. (The literal matches chaos.nodefaults.SPOT_PREEMPTED —
+        importing it here would cycle through fake/__init__.)"""
+        for node in await self.kube.list(
+                Node, labels={wk.GKE_NODEPOOL_LABEL: pool_name}):
+            set_node_condition(node, "SpotPreempted", "True",
+                               reason="PreemptionNotice",
+                               message="chaos: spot capacity reclaimed")
+            try:
+                await self.kube.update_status(node)
+            except NotFoundError:
+                pass
 
     async def remove_nodes(self, pool_name: str) -> None:
         for node in await self.kube.list(Node, labels={wk.GKE_NODEPOOL_LABEL: pool_name}):

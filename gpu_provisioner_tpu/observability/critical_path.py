@@ -5,8 +5,8 @@ endpoint counters; this module does it mechanically from a claim's trace. A
 claim's timeline [wave-start, ready] is partitioned by a priority sweep over
 its span intervals:
 
-    status-write > qr-wait > cloud-call > node-wait > lro > queue-wait
-        > reconcile
+    status-write > qr-wait > cloud-call > placement > node-wait > lro
+        > queue-wait > reconcile
 
 Time covered by nothing is the **requeue-idle-gap** — the claim existed and
 nobody was working on it (parked on ``Result(requeue_after=...)``, or
@@ -29,11 +29,16 @@ from typing import Iterable, Optional
 from .tracing import Trace
 
 # Higher priority wins where intervals overlap (a status-write inside a
-# reconcile inside the claim's LRO window is status-write time).
+# reconcile inside the claim's LRO window is status-write time). The
+# placement span covers the whole candidate walk and CONTAINS its
+# begin-create attempts — cloud-call outranks it so only the walk's own
+# overhead (memo checks, stockout bookkeeping between probes) lands on
+# the placement line.
 _PRIORITY = {
-    "status-write": 7,
-    "qr-wait": 6,
-    "cloud-call": 5,
+    "status-write": 8,
+    "qr-wait": 7,
+    "cloud-call": 6,
+    "placement": 5,
     "node-wait": 4,
     "lro": 3,
     "queue-wait": 2,
@@ -46,14 +51,15 @@ UNATTRIBUTED = "reconcile-exec"
 # Phases that count toward the attribution gate. IDLE is named — "the claim
 # sat in requeue backoff" is an answer, and the one the coalesced-status
 # work needs. UNATTRIBUTED is deliberately not.
-NAMED_PHASES = ("queue-wait", "lro", "node-wait", "qr-wait", "cloud-call",
-                "status-write", IDLE)
+NAMED_PHASES = ("queue-wait", "lro", "node-wait", "placement", "qr-wait",
+                "cloud-call", "status-write", IDLE)
 
 
 def classify(span_name: str) -> Optional[str]:
     """Span name → phase, or None for spans the sweep ignores."""
     base = span_name.split(":", 1)[0]
-    if base in ("queue-wait", "qr-wait", "status-write", "node-wait", "lro"):
+    if base in ("queue-wait", "qr-wait", "status-write", "node-wait", "lro",
+                "placement"):
         return base
     if base in ("begin-create", "begin-delete", "delete-queued"):
         return "cloud-call"

@@ -62,6 +62,15 @@ class Options:
     repair_rate_interval_seconds: float = 3600.0
     repair_burst: int = 3
     repair_max_concurrent: int = 2
+    # Capacity-aware placement: comma-separated zone candidate list in
+    # preference order ("" = single-zone legacy behavior, no fallback walk),
+    # the per-zone stockout-memo TTL, and the spot-zone demotion hysteresis
+    # (N preemptions inside the window sink the zone to the back of the
+    # spot candidate order).
+    zones: tuple = ()
+    stockout_memo_ttl_seconds: float = 5.0
+    spot_demote_threshold: int = 3
+    spot_demote_window_seconds: float = 60.0
     max_concurrent_reconciles: int = 64
     # Claim-shard horizontal scaling (controllers/registry.py): run N
     # replicas, each with a distinct SHARD_INDEX; per-claim work partitions
@@ -149,6 +158,13 @@ def parse_options(argv=None, env=None) -> Options:
             e.get("REPAIR_RATE_INTERVAL_SECONDS", "3600")),
         repair_burst=int(e.get("REPAIR_BURST", "3")),
         repair_max_concurrent=int(e.get("REPAIR_MAX_CONCURRENT", "2")),
+        zones=tuple(z.strip() for z in e.get("ZONES", "").split(",")
+                    if z.strip()),
+        stockout_memo_ttl_seconds=float(
+            e.get("STOCKOUT_MEMO_TTL_SECONDS", "5")),
+        spot_demote_threshold=int(e.get("SPOT_DEMOTE_THRESHOLD", "3")),
+        spot_demote_window_seconds=float(
+            e.get("SPOT_DEMOTE_WINDOW_SECONDS", "60")),
         max_concurrent_reconciles=int(e.get("MAX_CONCURRENT_RECONCILES", "64")),
         shards=int(e.get("SHARDS", "1")),
         shard_index=_shard_index_env(e),

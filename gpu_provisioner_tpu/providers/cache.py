@@ -174,6 +174,55 @@ class ReadThroughCache:
         return len(self._entries)
 
 
+class TTLMemo:
+    """TTL set-memo: remembers that a key was "bad" for ``ttl`` seconds.
+
+    The placement engine's per-zone stockout memo — after one claim eats a
+    RESOURCE_EXHAUSTED from a zone, ``mark(zone)`` makes ``active(zone)``
+    true for the TTL window so the N claims queued behind it skip the zone
+    instead of serially re-probing a dry pool. Consults count into
+    ``CACHE_STATS`` under ``name`` (hits = memo suppressed a probe,
+    misses = no active memo) so /metrics sees memo effectiveness the same
+    way it sees the read-through caches.
+    """
+
+    def __init__(self, name: str, ttl: float = 5.0):
+        self.name = name
+        self.ttl = ttl
+        self._stamps: dict[str, float] = {}
+        self.stats: dict[str, int] = {k: 0 for k in _STAT_KEYS}
+        self._agg = CACHE_STATS.setdefault(name, {k: 0 for k in _STAT_KEYS})
+
+    def _count(self, stat: str) -> None:
+        self.stats[stat] += 1
+        self._agg[stat] += 1
+
+    @staticmethod
+    def _now() -> float:
+        return asyncio.get_event_loop().time()
+
+    def mark(self, key: str) -> None:
+        self._stamps[key] = self._now()
+
+    def active(self, key: str) -> bool:
+        stamp = self._stamps.get(key)
+        if stamp is not None and self.ttl > 0 and self._now() - stamp < self.ttl:
+            self._count("hits")
+            return True
+        if stamp is not None:  # expired — next probe is live again
+            self._stamps.pop(key, None)
+            self._count("invalidations")
+        self._count("misses")
+        return False
+
+    def clear(self, key: str) -> None:
+        if self._stamps.pop(key, None) is not None:
+            self._count("invalidations")
+
+    def __len__(self) -> int:
+        return len(self._stamps)
+
+
 class CountingAPI:
     """Transparent per-endpoint call counter around a cloud API seam
     (``NodePoolsAPI`` / ``QueuedResourcesAPI``).

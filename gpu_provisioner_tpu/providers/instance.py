@@ -42,11 +42,16 @@ from ..apis.karpenter import NodeClaim
 from ..apis.serde import fmt_time, now, parse_time
 from ..errors import (
     CreateError, InsufficientCapacityError, NodeClaimNotFoundError,
+    REASON_CREATE_IN_PROGRESS, REASON_DEGRADED_POOL, REASON_INVALID_NAME,
+    REASON_INVALID_STORAGE_REQUEST, REASON_LAUNCH_FAILED,
+    REASON_NODES_NOT_READY, REASON_QUEUED_PROVISIONING, REASON_STOCKOUT,
+    REASON_UNRESOLVABLE_SHAPE,
 )
-from ..runtime.client import Client
+from ..runtime.client import Client, patch_retry
 from ..scheduling import Requirements
 from .cache import CountingAPI, ReadThroughCache
 from .operations import BackoffLadder, OP_DELETE, OperationTracker
+from .placement import Candidate, PlacementEngine
 from .gcp import (
     APIError, NodePool, NodePoolConfig, NodePoolsAPI, PlacementPolicy,
     QueuedResource, QueuedResourcesAPI, poll_until_done,
@@ -78,6 +83,12 @@ NODEPOOL_NAME_RE = re.compile(r"^[a-z](?:[-a-z0-9]{0,38}[a-z0-9])?$")
 # Annotation selecting the queued-resource path for a NodeClaim.
 PROVISIONING_MODE_ANNOTATION = "tpu.kaito.sh/provisioning-mode"
 MODE_QUEUED = "queued"
+
+# Per-claim placement attempt history: comma-joined Candidate keys
+# (zone/shape/tier) already verdicted RESOURCE_EXHAUSTED, recorded durably on
+# the claim so a crash-restart resumes the fallback walk at the next
+# candidate instead of re-probing the ones already tried.
+PLACEMENT_ATTEMPTS_ANNOTATION = "tpu.kaito.sh/placement-attempts"
 
 _PROVIDER_ID_RE = re.compile(r"^gce://(?P<project>[^/]+)/(?P<zone>[^/]+)/(?P<instance>.+)$")
 
@@ -158,6 +169,16 @@ class ProviderConfig:
     # would stretch every requeue by the TTL for zero saved calls (the
     # requeue cadence already spaces them out).
     qr_cache_ttl: float = 0.0
+    # Placement: the zones the provider may fall over to, most-preferred
+    # first; empty keeps the legacy single-zone behavior (`zone` is the only
+    # candidate, stockout maps to InsufficientCapacityError). The memo TTL
+    # bounds how long one RESOURCE_EXHAUSTED verdict suppresses re-probes of
+    # a dry zone/generation; the demote knobs drive spot-zone hysteresis
+    # (providers/placement.py).
+    zones: tuple[str, ...] = ()
+    stockout_memo_ttl: float = 5.0
+    spot_demote_threshold: int = 3
+    spot_demote_window: float = 60.0
     # Pre-fast-path list() (one kube Node list PER POOL, serially) — kept
     # only as the benchmark baseline (bench/bench_provision.py measures the
     # fast path against it). Never enable in production.
@@ -204,6 +225,16 @@ class InstanceProvider:
         # optional: spans cover the create/delete state-machine steps so the
         # critical-path analyzer can attribute a claim's ready-wall.
         self.tracer = tracer
+        # Placement engine (providers/placement.py): preference-ordered
+        # zone × shape × tier candidates, per-zone stockout memo, spot
+        # demotion hysteresis. The default single-zone/no-tier config yields
+        # exactly one candidate, keeping the legacy exhausted →
+        # InsufficientCapacityError contract byte-identical.
+        self.placement = PlacementEngine(
+            self.cfg.zones or (self.cfg.zone,),
+            stockout_ttl=self.cfg.stockout_memo_ttl,
+            demote_threshold=self.cfg.spot_demote_threshold,
+            demote_window=self.cfg.spot_demote_window)
         # Read-through caches (providers/cache.py): point lookups on the
         # cloud seams, singleflight-coalesced, explicitly invalidated by
         # create/delete/state transitions below.
@@ -279,15 +310,19 @@ class InstanceProvider:
         if not nodepool_name_valid(name):
             raise CreateError(
                 f"nodeclaim name {name!r} is not a valid node-pool name "
-                f"(must match {NODEPOOL_NAME_RE.pattern})", reason="InvalidName")
+                f"(must match {NODEPOOL_NAME_RE.pattern})",
+                reason=REASON_INVALID_NAME)
 
         reqs = Requirements.from_nodeclaim(nc)
         try:
-            shape = cat.resolve(reqs, nc.spec.resources.requests)
+            candidates = self.placement.candidates(
+                reqs, nc.spec.resources.requests)
         except (cat.UnknownShapeError, ValueError) as e:
             # ValueError: malformed numeric requirement/request strings — same
             # terminal fate as an unknown shape, never a retry loop.
-            raise CreateError(str(e), reason="UnresolvableShape") from e
+            raise CreateError(str(e), reason=REASON_UNRESOLVABLE_SHAPE) from e
+        # the first candidate is exactly the legacy catalog.resolve answer
+        shape = candidates[0].shape
         capacity_type = self._capacity_type(reqs)
 
         if self.tracker is not None:
@@ -301,14 +336,18 @@ class InstanceProvider:
         if self._queued_mode(nc, reqs):
             with self._span(name, "qr-wait", shape=shape.slice_name):
                 await self._ensure_queued_resource(nc, shape, capacity_type)
+            # queued capacity was reserved in the primary zone — the walk
+            # must not wander away from where the QueuedResource landed
+            candidates = candidates[:1]
 
         slice_identity = await self._slice_group_identity(nc)
-        pool = self._new_nodepool_object(nc, shape, capacity_type,
-                                         extra_labels=slice_identity)
-        try:
-            self._fence_check()
-            with self._span(name, "begin-create", hosts=shape.hosts):
-                op = await self.nodepools.begin_create(pool)
+        chosen, op, adopted = await self._walk_candidates(
+            nc, name, candidates, capacity_type, slice_identity)
+        shape = chosen.shape
+
+        if not adopted:
+            # cut line: begin_create is issued but neither the tracker nor
+            # the attempt annotation has recorded which candidate won
             self._crash("after_pool_begin_create", name)
             if self.tracker is not None:
                 # hand the LRO + node wait to the multiplexer and free the
@@ -317,30 +356,18 @@ class InstanceProvider:
                 self._register_create(name, shape.hosts)
                 raise CreateError(
                     f"nodepool {name} create in progress; requeueing",
-                    reason="CreateInProgress")
-            # poll at the node-wait cadence: the default 1s LRO poll left a
-            # completed create unobserved for up to a full second — at
-            # envtest/production config alike, the node wait owns pacing
-            await poll_until_done(op, interval=self.cfg.node_wait_interval)
-        except APIError as e:
-            if e.conflict:
-                # Crash-restart tolerance: a create from a previous
-                # incarnation (or a racing replica) owns this pool. Adopt
-                # it — resume the in-flight LRO by tracking (or polling)
-                # the pool's own state — rather than blind-waiting for
-                # nodes a pool that lands in ERROR will never produce
-                # (reference: instance.go:106-110, minus its blind wait).
-                log.info("nodepool %s create already in progress, adopting", name)
-                if self.tracker is not None:
-                    self._register_create(name, shape.hosts)
-                    raise CreateError(
-                        f"nodepool {name} create adopted; requeueing",
-                        reason="CreateInProgress") from e
-                await self._adopt_inflight_create(name)
-            elif e.exhausted:
-                raise InsufficientCapacityError(
-                    f"nodepool {name} ({shape.slice_name}): {e}") from e
-            else:
+                    reason=REASON_CREATE_IN_PROGRESS)
+            try:
+                # poll at the node-wait cadence: the default 1s LRO poll
+                # left a completed create unobserved for up to a full second
+                # — at envtest/production config alike, node wait owns pacing
+                await poll_until_done(op, interval=self.cfg.node_wait_interval)
+            except APIError as e:
+                if e.exhausted:
+                    # capacity verdict arrived via the LRO, not begin_create
+                    self.placement.note_stockout(chosen)
+                    raise InsufficientCapacityError(
+                        f"nodepool {name} ({shape.slice_name}): {e}") from e
                 raise CreateError(f"creating nodepool {name}: {e}") from e
 
         # cut line: the create LRO has completed server-side but nothing —
@@ -353,6 +380,125 @@ class InstanceProvider:
         self._pool_cache.invalidate(name)
         created = await self._get_pool(name)
         return self._to_instance(created, shape=shape, nodes=nodes)
+
+    async def _walk_candidates(self, nc: NodeClaim, name: str,
+                               candidates: list[Candidate],
+                               capacity_type: str,
+                               slice_identity: dict[str, str]
+                               ) -> tuple[Candidate, object, bool]:
+        """The fallback walk: try placement candidates in preference order
+        until one accepts the create. Returns ``(chosen, op, adopted)`` —
+        ``adopted`` means a conflicting in-flight create was adopted instead
+        of issuing a new one (``op`` is then None).
+
+        A candidate is skipped without a cloud probe when (a) its key is in
+        the claim's durable attempt history (crash-restart resume: never
+        re-probe — or worse, double-create behind — a candidate already
+        verdicted) or (b) the zone/generation stockout memo holds a live
+        verdict (N queued claims cost a dry zone ONE probe per TTL window,
+        and both skip kinds count as observed stockouts). Exhausted across
+        every candidate: single-candidate claims keep the legacy
+        ``InsufficientCapacityError`` contract; multi-candidate claims get
+        the terminal ``CreateError(reason=Stockout)`` the lifecycle turns
+        into an Event + claim deletion instead of a retry spin."""
+        attempted = self._attempted(nc)
+        last_err: Optional[APIError] = None
+        dry: list[str] = []
+        chosen: Optional[Candidate] = None
+        op = None
+        adopted = False
+        with self._span(name, "placement", candidates=len(candidates)):
+            for cand in candidates:
+                if cand.key in attempted or self.placement.suppressed(cand):
+                    dry.append(cand.key)
+                    continue
+                pool = self._new_nodepool_object(
+                    nc, cand.shape, capacity_type,
+                    extra_labels=slice_identity,
+                    zone=cand.zone, tier=cand.tier)
+                try:
+                    self._fence_check()
+                    with self._span(name, "begin-create",
+                                    hosts=cand.shape.hosts, zone=cand.zone):
+                        op = await self.nodepools.begin_create(pool)
+                except APIError as e:
+                    if e.conflict:
+                        # Crash-restart tolerance: a create from a previous
+                        # incarnation (or a racing replica) owns this pool.
+                        # Adopt it — resume the in-flight LRO by tracking
+                        # (or polling) the pool's own state — rather than
+                        # blind-waiting for nodes a pool that lands in ERROR
+                        # will never produce (reference: instance.go:106-110,
+                        # minus its blind wait).
+                        log.info("nodepool %s create already in progress, "
+                                 "adopting", name)
+                        if self.tracker is not None:
+                            self._register_create(name, cand.shape.hosts)
+                            raise CreateError(
+                                f"nodepool {name} create adopted; requeueing",
+                                reason=REASON_CREATE_IN_PROGRESS) from e
+                        chosen, adopted = cand, True
+                        break
+                    if e.exhausted:
+                        # zone verdict: memo it (followers skip the zone for
+                        # a TTL) and record it on the claim (restart resumes
+                        # at the NEXT candidate)
+                        self.placement.note_stockout(cand)
+                        await self._record_attempt(nc, cand.key)
+                        dry.append(cand.key)
+                        last_err = e
+                        continue
+                    raise CreateError(
+                        f"creating nodepool {name}: {e}") from e
+                chosen = cand
+                break
+        if chosen is None:
+            if len(candidates) == 1:
+                # legacy single-candidate contract: stockout maps to
+                # InsufficientCapacityError (launch deletes the claim and
+                # KAITO retries with a different shape)
+                detail = last_err or "stockout memo active for the only zone"
+                raise InsufficientCapacityError(
+                    f"nodepool {name} ({candidates[0].shape.slice_name}): "
+                    f"{detail}") from last_err
+            raise CreateError(
+                f"nodepool {name}: capacity exhausted across all "
+                f"{len(candidates)} placement candidates "
+                f"({', '.join(dry)})",
+                reason=REASON_STOCKOUT) from last_err
+        if chosen is not candidates[0]:
+            self.placement.note_fallback(candidates[0], chosen)
+            log.info("nodepool %s fell back to %s (wanted %s)",
+                     name, chosen.key, candidates[0].key)
+        if adopted:
+            await self._adopt_inflight_create(name)
+        return chosen, op, adopted
+
+    def _attempted(self, nc: NodeClaim) -> set[str]:
+        raw = nc.metadata.annotations.get(PLACEMENT_ATTEMPTS_ANNOTATION, "")
+        return {k for k in raw.split(",") if k}
+
+    async def _record_attempt(self, nc: NodeClaim, key: str) -> None:
+        """Durably append ``key`` to the claim's placement attempt history.
+        Best-effort: a claim not present in the store (direct provider use,
+        unit tests) keeps only the in-memory record — patch_retry returns
+        None on NotFound and the walk carries on."""
+        attempts = self._attempted(nc) | {key}
+        nc.metadata.annotations[PLACEMENT_ATTEMPTS_ANNOTATION] = \
+            ",".join(sorted(attempts))
+
+        def _mutate(obj: NodeClaim) -> bool:
+            anns = obj.metadata.annotations
+            merged = {k for k in
+                      anns.get(PLACEMENT_ATTEMPTS_ANNOTATION, "").split(",")
+                      if k}
+            if key in merged:
+                return False
+            merged.add(key)
+            anns[PLACEMENT_ATTEMPTS_ANNOTATION] = ",".join(sorted(merged))
+            return True
+
+        await patch_retry(self.kube, NodeClaim, nc.metadata.name, _mutate)
 
     async def _consume_tracked_create(self, op, name: str,
                                       shape: cat.SliceShape
@@ -367,7 +513,7 @@ class InstanceProvider:
                 # the name frees up once the delete op resolves
                 raise CreateError(
                     f"nodepool {name} is being deleted; requeueing",
-                    reason="CreateInProgress")
+                    reason=REASON_CREATE_IN_PROGRESS)
             # resolved teardown nobody consumed (a reaped claimless pool's
             # delete has no second delete() call): pop it or a NodeClaim
             # reusing the name would see "being deleted" forever
@@ -377,13 +523,14 @@ class InstanceProvider:
         if op.in_progress:
             raise CreateError(
                 f"nodepool {name} create in progress; requeueing",
-                reason="CreateInProgress")
+                reason=REASON_CREATE_IN_PROGRESS)
         self.tracker.pop(name)
         # terminal either way: any entry cached during the wait predates
         # the outcome (the blocking path invalidates at the same point)
         self._pool_cache.invalidate(name)
         if not op.succeeded:
-            raise CreateError(op.message, reason=op.reason or "LaunchFailed")
+            raise CreateError(op.message,
+                              reason=op.reason or REASON_LAUNCH_FAILED)
         # cut line: the create LRO has completed server-side but nothing —
         # cache invalidation, node wait, claim status — has recorded it yet
         self._crash("before_lro_done", name)
@@ -394,8 +541,12 @@ class InstanceProvider:
                 self._pool_cache.invalidate(name)
                 raise CreateError(
                     f"nodepool {name} vanished after its create completed; "
-                    "requeueing", reason="CreateInProgress") from e
+                    "requeueing", reason=REASON_CREATE_IN_PROGRESS) from e
             raise CreateError(f"reading created nodepool {name}: {e}") from e
+        # a fallback walk may have created the pool as a less-preferred
+        # shape: the pool's own instance-type label is authoritative
+        shape = cat.lookup(
+            created.config.labels.get(wk.INSTANCE_TYPE_LABEL, "")) or shape
         nodes = ready_workers(await self._nodes_of_pool(name))
         return self._to_instance(created, shape=shape, nodes=nodes)
 
@@ -434,7 +585,7 @@ class InstanceProvider:
             try:
                 return await self.create(nc)
             except CreateError as e:
-                if e.reason != "CreateInProgress":
+                if e.reason != REASON_CREATE_IN_PROGRESS:
                     raise
                 remaining = deadline - asyncio.get_event_loop().time()
                 if remaining <= 0:
@@ -492,26 +643,26 @@ class InstanceProvider:
                     raise CreateError(
                         f"nodepool {name} vanished while adopting an "
                         "in-flight create; requeueing",
-                        reason="CreateInProgress") from e
+                        reason=REASON_CREATE_IN_PROGRESS) from e
                 raise CreateError(f"adopting nodepool {name}: {e}") from e
             if pool.status == NP_ERROR:
                 self._pool_cache.invalidate(name)
                 raise CreateError(
                     f"nodepool {name} is ERROR after an adopted create: "
                     f"{pool.status_message or 'unknown failure'}",
-                    reason="DegradedPool")
+                    reason=REASON_DEGRADED_POOL)
             if pool.status == NP_STOPPING:
                 self._pool_cache.invalidate(name)
                 raise CreateError(
                     f"nodepool {name} is being deleted; requeueing",
-                    reason="CreateInProgress")
+                    reason=REASON_CREATE_IN_PROGRESS)
             if pool.status != NP_PROVISIONING:
                 return  # RUNNING/RECONCILING — fall through to the node wait
             if ladder.expired():
                 raise CreateError(
                     f"nodepool {name} still PROVISIONING after {budget:.0f}s "
                     "adopted-create wait; requeueing",
-                    reason="CreateInProgress")
+                    reason=REASON_CREATE_IN_PROGRESS)
             await ladder.sleep()
 
     def _queued_mode(self, nc: NodeClaim, reqs: Requirements) -> bool:
@@ -553,7 +704,7 @@ class InstanceProvider:
         if qr.state != QR_ACTIVE:
             raise CreateError(
                 f"queued resource {name} is {qr.state}; requeueing",
-                reason="QueuedProvisioning")
+                reason=REASON_QUEUED_PROVISIONING)
 
     async def _slice_group_identity(self, nc: NodeClaim) -> dict[str, str]:
         """Multi-slice identity labels for a slice-group member.
@@ -636,15 +787,19 @@ class InstanceProvider:
 
     def _new_nodepool_object(self, nc: NodeClaim, shape: cat.SliceShape,
                              capacity_type: str,
-                             extra_labels: Optional[dict[str, str]] = None
-                             ) -> NodePool:
+                             extra_labels: Optional[dict[str, str]] = None,
+                             zone: str = "", tier: str = "") -> NodePool:
         """Build the desired NodePool (analog: newAgentPoolObject,
-        instance.go:321-369)."""
+        instance.go:321-369). ``zone``/``tier`` record the placement
+        verdict on the pool's labels (and through them on every node the
+        slice materializes); they default off so direct callers keep the
+        pre-placement shape."""
         labels = {
             wk.NODEPOOL_LABEL: wk.KAITO_NODEPOOL_NAME,           # :330
             wk.KAITO_MACHINE_TYPE_LABEL: "tpu",                  # :335-339
             wk.KAITO_CREATION_TIMESTAMP_LABEL: ts_label(now()),  # :340-342
-            **shape.node_labels(slice_id=nc.metadata.name),
+            **shape.node_labels(slice_id=nc.metadata.name, zone=zone,
+                                capacity_tier=tier or capacity_type),
             **(extra_labels or {}),
         }
         for key in (wk.KAITO_WORKSPACE_LABEL, wk.KAITO_RAGENGINE_LABEL,
@@ -659,7 +814,7 @@ class InstanceProvider:
                 disk = parse_gi(storage)  # :344-353 storage request → disk size
             except ValueError as e:
                 raise CreateError(f"invalid storage request {storage!r}: {e}",
-                                  reason="InvalidStorageRequest") from e
+                                  reason=REASON_INVALID_STORAGE_REQUEST) from e
 
         image = image_family_to_image_type(
             nc.metadata.annotations.get(wk.KAITO_NODE_IMAGE_FAMILY_ANNOTATION, ""))
@@ -672,7 +827,7 @@ class InstanceProvider:
                 disk_size_gb=disk,
                 labels=labels,
                 taints=taints,
-                spot=capacity_type == wk.CAPACITY_TYPE_SPOT,
+                spot=(tier or capacity_type) == wk.CAPACITY_TYPE_SPOT,
                 image_type=image,
             ),
             initial_node_count=shape.hosts,  # generalizes Count=1 (:365)
@@ -709,7 +864,7 @@ class InstanceProvider:
             await ladder.sleep()
         raise CreateError(
             f"nodepool {pool}: only {len(ready)}/{hosts} nodes appeared with "
-            "providerIDs before timeout", reason="NodesNotReady")
+            "providerIDs before timeout", reason=REASON_NODES_NOT_READY)
 
     async def _nodes_of_pool(self, pool: str) -> list[Node]:
         return await self.kube.list(Node, labels={wk.GKE_NODEPOOL_LABEL: pool})
@@ -793,8 +948,9 @@ class InstanceProvider:
             id=pids[0] if pids else "",
             image_id=pool.config.image_type,
             type=shape.name if shape else pool.config.machine_type,
-            capacity_type=(wk.CAPACITY_TYPE_SPOT if pool.config.spot
-                           else wk.CAPACITY_TYPE_ON_DEMAND),
+            capacity_type=(pool.config.labels.get(wk.TPU_CAPACITY_TIER_LABEL)
+                           or (wk.CAPACITY_TYPE_SPOT if pool.config.spot
+                               else wk.CAPACITY_TYPE_ON_DEMAND)),
             labels=dict(pool.config.labels),
             topology=shape.topology if shape else "",
             hosts=pool.initial_node_count,

@@ -49,6 +49,11 @@ from typing import Awaitable, Callable, Optional
 
 from ..apis import labels as wk
 from ..apis.core import Node
+from ..errors import (
+    REASON_CREATE_IN_PROGRESS, REASON_CREATED, REASON_DEGRADED_POOL,
+    REASON_DELETE_TIMEOUT, REASON_DELETED, REASON_DISCARDED,
+    REASON_NODES_NOT_READY, REASON_SUPERSEDED,
+)
 
 log = logging.getLogger("providers.operations")
 
@@ -314,7 +319,7 @@ class OperationTracker:
                 return op
             # delete supersedes create: complete the create as failed so a
             # waiter blocked on op.done (create_and_wait) is released
-            self._complete(op, PHASE_FAILED, "Superseded",
+            self._complete(op, PHASE_FAILED, REASON_SUPERSEDED,
                            f"nodepool {name} create superseded by delete",
                            notify=False)
         op = TrackedOperation(kind=kind, name=name, hosts=hosts,
@@ -345,7 +350,7 @@ class OperationTracker:
         across claim churn."""
         op = self._ops.pop(name, None)
         if op is not None and op.in_progress:
-            self._complete(op, PHASE_FAILED, "Discarded",
+            self._complete(op, PHASE_FAILED, REASON_DISCARDED,
                            f"nodepool {name} is gone; operation discarded",
                            notify=False)
 
@@ -432,14 +437,14 @@ class OperationTracker:
         if _now() < op.deadline:
             return False
         if op.kind == OP_DELETE:
-            self._complete(op, PHASE_FAILED, "DeleteTimeout",
+            self._complete(op, PHASE_FAILED, REASON_DELETE_TIMEOUT,
                            f"nodepool {op.name} still present after "
                            f"{op.deadline - op.started:.0f}s delete wait")
         else:
             # retryable by convention: the consumer requeues and the retry's
             # begin_create conflict re-registers (same contract the blocking
             # adoption path had)
-            self._complete(op, PHASE_FAILED, "CreateInProgress",
+            self._complete(op, PHASE_FAILED, REASON_CREATE_IN_PROGRESS,
                            f"nodepool {op.name} operation still unresolved "
                            f"after {op.deadline - op.started:.0f}s; requeueing")
         return True
@@ -448,24 +453,24 @@ class OperationTracker:
         """Advance one op against the batched snapshot. True on completion."""
         if op.kind == OP_DELETE:
             if pool is None:
-                self._complete(op, PHASE_SUCCEEDED, "Deleted",
+                self._complete(op, PHASE_SUCCEEDED, REASON_DELETED,
                                f"nodepool {op.name} deleted")
                 return True
             return self._expire(op)
 
         # create
         if pool is None:
-            self._complete(op, PHASE_FAILED, "CreateInProgress",
+            self._complete(op, PHASE_FAILED, REASON_CREATE_IN_PROGRESS,
                            f"nodepool {op.name} vanished while its create "
                            "was in flight; requeueing")
             return True
         if pool.status == _NP_ERROR:
-            self._complete(op, PHASE_FAILED, "DegradedPool",
+            self._complete(op, PHASE_FAILED, REASON_DEGRADED_POOL,
                            f"nodepool {op.name} is ERROR: "
                            f"{pool.status_message or 'unknown failure'}")
             return True
         if pool.status == _NP_STOPPING:
-            self._complete(op, PHASE_FAILED, "CreateInProgress",
+            self._complete(op, PHASE_FAILED, REASON_CREATE_IN_PROGRESS,
                            f"nodepool {op.name} is being deleted; requeueing")
             return True
         if pool.status == _NP_PROVISIONING:
@@ -479,12 +484,12 @@ class OperationTracker:
             Node, labels={wk.GKE_NODEPOOL_LABEL: op.name})
         ready = sum(1 for n in nodes if n.spec.provider_id)
         if ready >= op.hosts:
-            self._complete(op, PHASE_SUCCEEDED, "Created",
+            self._complete(op, PHASE_SUCCEEDED, REASON_CREATED,
                            f"nodepool {op.name} running with "
                            f"{ready}/{op.hosts} nodes")
             return True
         if _now() >= op.deadline:
-            self._complete(op, PHASE_FAILED, "NodesNotReady",
+            self._complete(op, PHASE_FAILED, REASON_NODES_NOT_READY,
                            f"nodepool {op.name}: only {ready}/{op.hosts} "
                            "nodes appeared with providerIDs before timeout")
             return True

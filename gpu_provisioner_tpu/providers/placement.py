@@ -1,0 +1,178 @@
+"""Capacity-aware placement: zone × generation × capacity-tier candidates.
+
+Capacity exhaustion used to be a generic retryable error — the provisioner
+could only retry *into* a dry zone, never route *around* it (ROADMAP item 3:
+the fake cloud was single-zone with infinite capacity, so nothing exercised
+the difference). This module makes placement a first-class decision:
+
+- :meth:`PlacementEngine.candidates` expands the NodeClaim requirements into
+  a preference-ordered candidate list — shape preference (``catalog.
+  resolve_all`` order) × capacity-tier preference (``tpu.kaito.sh/
+  capacity-tier`` requirement order) × zone preference (``topology.
+  kubernetes.io/zone`` requirement order, else the configured zone list),
+  with the zone varying fastest so a stockout falls over to a sibling zone
+  before giving up a tier or a shape.
+- A per-``zone/generation`` **stockout memo** (:class:`~.cache.TTLMemo`)
+  remembers a RESOURCE_EXHAUSTED verdict for a TTL window, so a wave of N
+  queued claims costs the dry zone ONE probe per window instead of N serial
+  probes (the instance provider consults it before every candidate).
+- **Spot demotion hysteresis**: zones whose spot pools keep getting
+  preemption-reclaimed (≥ ``demote_threshold`` preemptions inside
+  ``demote_window`` seconds) sink to the end of the spot-tier zone order, so
+  a flapping spot zone stops being the first thing a reclaim wave's
+  replacement claims land back on.
+
+Counters live in module registries (``STOCKOUTS`` / ``FALLBACKS`` /
+``SPOT_PREEMPTIONS``) that ``controllers/metrics.py`` samples at scrape time
+— the REPAIR_STATS convention: this layer never imports prometheus.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from .. import catalog as cat
+from ..apis import labels as wk
+from ..scheduling import Requirements
+from .cache import TTLMemo
+
+# ---------------------------------------------------------------- registries
+
+# zone -> cumulative RESOURCE_EXHAUSTED verdicts observed at begin_create.
+STOCKOUTS: dict[str, int] = defaultdict(int)
+
+# (from_zone, to_zone) -> cumulative fallback placements: the claim wanted
+# from_zone (its first candidate) but landed in to_zone.
+FALLBACKS: dict[tuple[str, str], int] = defaultdict(int)
+
+# zone -> cumulative spot preemptions noted by the repair path.
+SPOT_PREEMPTIONS: dict[str, int] = defaultdict(int)
+
+# zone -> recent preemption timestamps (loop clock), the demotion evidence.
+# Module-level (not per-engine) deliberately: preemptions are observed by the
+# health controller, placement decisions are made by the instance provider —
+# the two rendezvous here the way REPAIR_STATS rendezvous health and metrics.
+_PREEMPT_TIMES: dict[str, list[float]] = defaultdict(list)
+
+# Preference order when a claim constrains the tier axis with a non-In
+# requirement (Exists / NotIn): cheapest-to-lose first.
+DEFAULT_TIERS = (wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_ON_DEMAND,
+                 wk.CAPACITY_TYPE_SPOT)
+
+
+def _now() -> float:
+    return asyncio.get_event_loop().time()
+
+
+def note_spot_preemption(zone: str) -> None:
+    """Record a spot preemption against ``zone`` (called from the repair
+    path when a SpotPreempted condition commits a repair). Feeds both the
+    /metrics counter and the demotion hysteresis window."""
+    zone = zone or "unknown"
+    SPOT_PREEMPTIONS[zone] += 1
+    _PREEMPT_TIMES[zone].append(_now())
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One placement candidate: a slice shape in a zone at a capacity tier."""
+
+    shape: cat.SliceShape
+    zone: str
+    tier: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity for the per-claim attempt history (annotation)."""
+        return f"{self.zone}/{self.shape.name}/{self.tier}"
+
+    @property
+    def memo_key(self) -> str:
+        """Stockout memo granularity: a zone runs dry per *generation* (the
+        chip pools are per-generation), not per exact shape or tier."""
+        return f"{self.zone}/{self.shape.generation}"
+
+
+class PlacementEngine:
+    """Preference-ordered candidate expansion + stockout memo + demotion."""
+
+    def __init__(self, zones: Iterable[str], stockout_ttl: float = 5.0,
+                 demote_threshold: int = 3, demote_window: float = 60.0):
+        self.zones = [z for z in zones if z]
+        if not self.zones:
+            raise ValueError("PlacementEngine needs at least one zone")
+        self.memo = TTLMemo("placement.stockout", ttl=stockout_ttl)
+        self.demote_threshold = demote_threshold
+        self.demote_window = demote_window
+
+    # -------------------------------------------------------------- ordering
+    def candidates(self, reqs: Requirements,
+                   resources: Optional[dict[str, str]] = None
+                   ) -> list[Candidate]:
+        """Expand requirements into the fallback-walk order. The FIRST
+        element is the legacy single-candidate answer (``catalog.resolve``'s
+        shape, the claim's declared tier, the most-preferred zone), so the
+        no-stockout path is byte-identical to pre-placement behavior.
+        Raises :class:`~..catalog.UnknownShapeError` when no shape fits."""
+        shapes = cat.resolve_all(reqs, resources)
+        tiers = self._tiers(reqs)
+        zones = reqs.preference(wk.ZONE_LABEL, self.zones)
+        out: list[Candidate] = []
+        for shape in shapes:
+            for tier in tiers:
+                for zone in self._ordered_zones(zones, tier):
+                    out.append(Candidate(shape=shape, zone=zone, tier=tier))
+        if not out:
+            raise cat.UnknownShapeError(
+                f"requirements admit no placement candidate "
+                f"(zones {zones}, tiers {tiers})")
+        return out
+
+    def _tiers(self, reqs: Requirements) -> list[str]:
+        """Tier axis. An explicit ``tpu.kaito.sh/capacity-tier`` requirement
+        is a *ranking* (fall across tiers in its order); otherwise the claim
+        gets exactly its karpenter capacity-type — tier fallback is opt-in,
+        a spot claim must never silently land on-demand."""
+        if reqs.has(wk.TPU_CAPACITY_TIER_LABEL):
+            tiers = reqs.preference(wk.TPU_CAPACITY_TIER_LABEL, DEFAULT_TIERS)
+            if tiers:
+                return tiers
+        vals = reqs.get(wk.CAPACITY_TYPE_LABEL).values()
+        return [vals[0]] if vals else [wk.CAPACITY_TYPE_ON_DEMAND]
+
+    def _ordered_zones(self, zones: list[str], tier: str) -> list[str]:
+        if tier != wk.CAPACITY_TYPE_SPOT:
+            return zones
+        healthy = [z for z in zones if not self.spot_demoted(z)]
+        demoted = [z for z in zones if self.spot_demoted(z)]
+        return healthy + demoted
+
+    # ------------------------------------------------------------ hysteresis
+    def spot_demoted(self, zone: str) -> bool:
+        """True while ``zone`` has accumulated ≥ threshold spot preemptions
+        inside the sliding window — demoted, not excluded: a claim that can
+        only go there still does, last."""
+        times = _PREEMPT_TIMES.get(zone)
+        if not times:
+            return False
+        cutoff = _now() - self.demote_window
+        recent = [t for t in times if t >= cutoff]
+        _PREEMPT_TIMES[zone] = recent
+        return len(recent) >= self.demote_threshold
+
+    # ------------------------------------------------------------------ memo
+    def suppressed(self, cand: Candidate) -> bool:
+        """True while the stockout memo holds a live verdict for the
+        candidate's zone/generation — the walk treats it as an observed
+        stockout without spending a cloud probe."""
+        return self.memo.active(cand.memo_key)
+
+    def note_stockout(self, cand: Candidate) -> None:
+        self.memo.mark(cand.memo_key)
+        STOCKOUTS[cand.zone] += 1
+
+    def note_fallback(self, wanted: Candidate, placed: Candidate) -> None:
+        FALLBACKS[(wanted.zone, placed.zone)] += 1

@@ -89,6 +89,23 @@ class Requirements:
     def keys(self) -> list[str]:
         return list(self._by_key)
 
+    def preference(self, key: str, defaults: Iterable[str]) -> list[str]:
+        """Preference-ordered allowed values for ``key``.
+
+        An In-requirement pins the order to its declared values; any other
+        requirement filters ``defaults`` through :meth:`Requirement.matches`
+        (NotIn drops the excluded ones); no requirement at all returns
+        ``defaults`` unchanged. This is the placement engine's candidate-axis
+        expansion (zone / capacity-tier): declared values are a *ranking*,
+        not just a set.
+        """
+        req = self._by_key.get(key)
+        if req is None:
+            return list(defaults)
+        if req.operator == kv1.IN:
+            return req.values()
+        return [v for v in defaults if req.matches(v)]
+
     def compatible(self, labels: dict[str, str]) -> bool:
         return all(r.matches(labels.get(k)) for k, r in self._by_key.items())
 

@@ -1,0 +1,17 @@
+"""PL013 true positives: CreateError reasons spelled as string literals."""
+
+from gpu_provisioner_tpu.errors import CreateError
+
+
+def launch(pool):
+    if pool is None:
+        raise CreateError("pool vanished mid-create", "CreateInProgress")
+    if pool.status == "ERROR":
+        raise CreateError("pool landed ERROR", reason="DegradedPool")
+    return pool
+
+
+def classify(e):
+    if e.reason == "Stockout":
+        return "terminal"
+    return "retry"

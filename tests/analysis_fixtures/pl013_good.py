@@ -1,0 +1,24 @@
+"""PL013 true negatives: reasons come from the central enum; reason-ish
+strings OUTSIDE the CreateError vocabulary stay legal."""
+
+from gpu_provisioner_tpu.errors import (
+    CreateError, REASON_DEGRADED_POOL, REASON_STOCKOUT, reason_is_terminal,
+)
+
+
+def launch(pool):
+    if pool is None:
+        raise CreateError("capacity exhausted", reason=REASON_STOCKOUT)
+    if pool.status == "ERROR":
+        raise CreateError("pool landed ERROR", REASON_DEGRADED_POOL)
+    return pool
+
+
+def classify(e, diag):
+    if reason_is_terminal(e.reason):
+        return "terminal"
+    # a repair diagnosis reason is a node condition TYPE, not a CreateError
+    # reason — comparing it to a non-enum literal is not a finding
+    if diag.reason == "SpotPreempted":
+        return "repair"
+    return "retry"

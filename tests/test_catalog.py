@@ -77,6 +77,22 @@ def test_node_labels_and_capacity():
     assert cap[wk.TPU_RESOURCE_NAME] == "8"
 
 
+def test_node_labels_record_placement_verdict():
+    """Zone/tier parity: the placement walk's verdict rides the pool labels
+    onto every node of the slice — and stays absent for direct callers that
+    never made a placement decision."""
+    s = catalog.lookup("tpu-v5e-16")
+    bare = s.node_labels(slice_id="pool-abc")
+    assert wk.ZONE_LABEL not in bare
+    assert wk.TPU_CAPACITY_TIER_LABEL not in bare
+    placed = s.node_labels(slice_id="pool-abc", zone="us-central2-c",
+                           capacity_tier="spot")
+    assert placed[wk.ZONE_LABEL] == "us-central2-c"
+    assert placed[wk.TPU_CAPACITY_TIER_LABEL] == "spot"
+    # the placement labels ride along without disturbing the slice identity
+    assert placed[wk.TPU_SLICE_ID_LABEL] == "pool-abc"
+
+
 def test_requirements_algebra():
     r = reqs((wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v5e", "v5p"]),
              (wk.TPU_ACCELERATOR_LABEL, kv1.IN, ["v5p"]))

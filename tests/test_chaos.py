@@ -134,10 +134,15 @@ async def test_soak_flaky_cloud_converges():
 async def test_soak_stockout_bursts_terminate_cleanly():
     """First creates hit RESOURCE_EXHAUSTED: exactly those claims are
     terminally deleted (KAITO's re-shape contract), the rest reach Ready,
-    and the stockout victims leave nothing behind."""
-    policy = chaos.profile("stockout", seed=SEED)
+    and the stockout victims leave nothing behind.
+
+    The memo TTL is zeroed: this soak pins the PRE-memo burst contract
+    (exactly the probed claims die); the memo's N-claims-one-probe behavior
+    has its own soak in tests/test_placement.py."""
+    policy = chaos.profile("stockout-flaky", seed=SEED)
     names = [f"so{i}" for i in range(5)]
-    async with chaos_env(policy, launch_timeout=10.0) as env:
+    async with chaos_env(policy, launch_timeout=10.0,
+                         stockout_memo_ttl=0.0) as env:
         for n in names:
             await env.client.create(make_nodeclaim(n))
         ready, gone = await converge(env, names, timeout=30.0)
@@ -145,6 +150,25 @@ async def test_soak_stockout_bursts_terminate_cleanly():
         assert len(gone) == 2, f"want 2 stockout deletions, got {sorted(gone)}"
         assert policy.injected["error:nodepools.begin_create"] >= 2
         await assert_no_leaks_and_drained(env, ready)
+
+
+@async_test
+async def test_soak_stockout_window_terminates_inside_claims():
+    """The capacity-model ``stockout`` profile dries EVERY zone for its
+    first second: claims whose placement walk runs inside the window are
+    terminally deleted (single-candidate legacy contract — the claim can
+    never launch as specified) and leave nothing behind."""
+    policy = chaos.profile("stockout", seed=SEED)
+    names = [f"sw{i}" for i in range(2)]
+    async with chaos_env(policy, launch_timeout=10.0) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=30.0)
+        assert gone == set(names), \
+            f"dry-window claims must terminate, got ready={sorted(ready)}"
+        assert policy.injected_total("stockout:") >= 1, \
+            "the dry window never fired"
+        await assert_no_leaks_and_drained(env, set())
 
 
 @async_test

@@ -1,0 +1,345 @@
+"""Capacity-aware placement soaks (PR 10).
+
+Four scenario groups over the zone × generation × tier placement walk:
+
+1. **Zonal stockout survival** — a 50-claim wave with one of three zones
+   chaos-dry: every claim lands in a surviving zone, nothing wedges, and the
+   stockout memo holds the dry zone to one probe per TTL window.
+2. **Spot preemption reclaim** — the cloud preempts every spot slice in a
+   wave; the SpotPreempted repair path replaces them within budget, the
+   mass-delete breaker never trips, and on-demand neighbors are untouched.
+3. **Crash × fallback matrix** — the operator dies mid-fallback-walk; the
+   durable attempt annotation + conflict adoption resume the walk at the
+   right candidate with no duplicate pool and no re-probe of verdicted zones.
+4. **Zero capacity everywhere** — exhausted across every candidate is the
+   terminal ``CreateError(reason=Stockout)``: Warning Event, claim deleted,
+   and followers inside the memo TTL terminate at zero cloud probes.
+
+Deterministic for a fixed seed, like the chaos suite (CHAOS_SEED=<n>
+make capacity reproduces a failure).
+"""
+
+import asyncio
+from collections import defaultdict
+
+import pytest
+
+from gpu_provisioner_tpu import catalog, chaos
+from gpu_provisioner_tpu.apis import karpenter as kv1
+from gpu_provisioner_tpu.apis import labels as wk
+from gpu_provisioner_tpu.apis.core import Event, Node
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+from gpu_provisioner_tpu.chaos import SPOT_PREEMPTED
+from gpu_provisioner_tpu.controllers.health import REPAIR_STATS
+from gpu_provisioner_tpu.controllers.metrics import (
+    FALLBACK_PLACEMENTS_TOTAL, SPOT_PREEMPTIONS_TOTAL, STOCKOUTS_TOTAL,
+    update_runtime_gauges,
+)
+from gpu_provisioner_tpu.envtest import EnvtestOptions, RestartableEnv
+from gpu_provisioner_tpu.errors import REASON_STOCKOUT
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.providers import placement
+from gpu_provisioner_tpu.providers.instance import (
+    PLACEMENT_ATTEMPTS_ANNOTATION,
+)
+from gpu_provisioner_tpu.providers.placement import (
+    PlacementEngine, note_spot_preemption,
+)
+from gpu_provisioner_tpu.runtime.client import NotFoundError
+
+from .conftest import async_test
+from .test_catalog import reqs
+from .test_chaos import (
+    SEED, assert_no_leaks_and_drained, chaos_env, converge,
+)
+
+pytestmark = pytest.mark.capacity
+
+ZONE_A = "us-central2-a"
+ZONE_B = "us-central2-b"      # the chaos-dry zone in zonal_stockout (*-b)
+ZONE_C = "us-central2-c"
+
+
+def spot_claim(name: str) -> NodeClaim:
+    """A claim pinned to the spot capacity tier."""
+    nc = make_nodeclaim(name)
+    nc.spec.requirements.append(kv1.NodeSelectorRequirement(
+        key=wk.CAPACITY_TYPE_LABEL, operator=kv1.IN,
+        values=[wk.CAPACITY_TYPE_SPOT]))
+    return nc
+
+
+# ------------------------------------------------------------- engine units
+
+def test_candidates_zone_varies_fastest_and_first_is_legacy():
+    eng = PlacementEngine(["pz-a", "pz-b"])
+    r = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["tpu-v5e-8"]))
+    cands = eng.candidates(r)
+    assert [c.zone for c in cands[:2]] == ["pz-a", "pz-b"]
+    # first candidate is byte-identical to the legacy single answer
+    assert cands[0].shape.name == catalog.resolve(r).name
+    assert cands[0].tier == wk.CAPACITY_TYPE_ON_DEMAND
+    # an explicit zone requirement is both a ranking and a filter
+    r2 = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["tpu-v5e-8"]),
+              (wk.ZONE_LABEL, kv1.IN, ["pz-b"]))
+    assert [c.zone for c in eng.candidates(r2)] == ["pz-b"]
+
+
+@async_test
+async def test_spot_demotion_hysteresis_sinks_flapping_zone():
+    """Enough preemptions inside the window demote a spot zone to the back
+    of the candidate order — demoted, not excluded."""
+    eng = PlacementEngine(["dz-a", "dz-b"], demote_threshold=2,
+                          demote_window=60.0)
+    try:
+        note_spot_preemption("dz-a")
+        assert not eng.spot_demoted("dz-a"), "one preemption is not a flap"
+        note_spot_preemption("dz-a")
+        assert eng.spot_demoted("dz-a")
+        r = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["tpu-v5e-8"]),
+                 (wk.CAPACITY_TYPE_LABEL, kv1.IN, [wk.CAPACITY_TYPE_SPOT]))
+        assert [c.zone for c in eng.candidates(r)] == ["dz-b", "dz-a"]
+        # the demotion only reorders the SPOT tier
+        r_od = reqs((wk.INSTANCE_TYPE_LABEL, kv1.IN, ["tpu-v5e-8"]))
+        assert [c.zone for c in eng.candidates(r_od)] == ["dz-a", "dz-b"]
+    finally:
+        placement._PREEMPT_TIMES.pop("dz-a", None)
+
+
+# ------------------------------------------------- zonal stockout survival
+
+WAVE = 50
+
+
+@async_test
+async def test_zonal_stockout_wave_routes_around_dry_zone():
+    """One of three zones dries up mid-wave: 100% of the wave lands in the
+    surviving zones, zero claims wedge or terminate, and the stockout memo
+    holds the dry zone to ≤ 1 probe per TTL window.
+
+    Reconciles are serialized (one worker) so the probe count is exact: the
+    first claim to walk past the drained preferred zone pays ONE probe of
+    the dry zone; every follower is memo-suppressed."""
+    policy = chaos.profile("zonal_stockout", seed=SEED)
+    zones = {
+        ZONE_A: {"v5e": 8},        # room for exactly one v5e-8 slice
+        ZONE_B: {"v5e": 10_000},   # ample chips — but chaos-dry
+        ZONE_C: {"v5e": 10_000},
+    }
+    stockouts_b0 = placement.STOCKOUTS.get(ZONE_B, 0)
+    fallbacks0 = placement.FALLBACKS.get((ZONE_A, ZONE_C), 0)
+    ctr_stockout0 = STOCKOUTS_TOTAL.labels(ZONE_B)._value.get()
+    ctr_fallback0 = FALLBACK_PLACEMENTS_TOTAL.labels(ZONE_A, ZONE_C)._value.get()
+    names = [f"zs{i}" for i in range(WAVE)]
+    async with chaos_env(policy, launch_timeout=30.0, zones=zones,
+                         stockout_memo_ttl=30.0,
+                         max_concurrent_reconciles=1) as env:
+        # the first claim drains zone a, so the rest of the wave has to walk
+        # through the chaos-dry zone b before landing in c
+        await env.client.create(make_nodeclaim(names[0]))
+        await env.wait_ready(names[0], timeout=20)
+        for n in names[1:]:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=45.0)
+        assert ready == set(names), f"claims lost to the dry zone: {sorted(gone)}"
+        # 100% placed in surviving zones — read the zone off every node
+        nodes = await env.client.list(Node)
+        landed = {n.metadata.labels.get(wk.ZONE_LABEL) for n in nodes}
+        assert landed <= {ZONE_A, ZONE_C}, f"nodes in the dry zone: {landed}"
+        assert ZONE_C in landed, "the fallback zone never received the wave"
+        # ≤ 1 probe of the dry zone per memo TTL (whole wave fits one window)
+        dry_probes = env.cloud.nodepools.calls[f"begin_create:{ZONE_B}"]
+        assert dry_probes == 1, f"dry zone probed {dry_probes}× in one TTL"
+        # preferred zone: one filling create + one exhausted probe
+        assert env.cloud.nodepools.calls[f"begin_create:{ZONE_A}"] <= 2
+        await assert_no_leaks_and_drained(env, ready)
+        update_runtime_gauges(env.manager)
+    assert placement.STOCKOUTS.get(ZONE_B, 0) > stockouts_b0
+    assert placement.FALLBACKS.get((ZONE_A, ZONE_C), 0) > fallbacks0
+    assert STOCKOUTS_TOTAL.labels(ZONE_B)._value.get() > ctr_stockout0
+    assert (FALLBACK_PLACEMENTS_TOTAL.labels(ZONE_A, ZONE_C)._value.get()
+            > ctr_fallback0)
+
+
+# ------------------------------------------------- spot preemption reclaim
+
+def _start_replacer(env, builders):
+    """KAITO simulation (tests/test_health.py idiom): repair deletes a
+    NodeClaim; the workspace controller recreates it — spot claims come back
+    as spot claims."""
+    counts = defaultdict(int)
+
+    async def run():
+        # provlint: disable=unbounded-sleep-poll — not a poll-until: this
+        # simulator runs until the test cancels the returned task
+        while True:
+            for name, build in builders.items():
+                try:
+                    await env.client.get(NodeClaim, name)
+                except NotFoundError:
+                    try:
+                        await env.client.create(build(name))
+                        counts[name] += 1
+                    except Exception:  # noqa: BLE001 — create race; next lap
+                        pass
+                except Exception:  # noqa: BLE001 — transient read error
+                    pass
+            await asyncio.sleep(0.05)
+
+    return asyncio.create_task(run()), counts
+
+
+async def _wait_wave_recovered(env, policy, names, timeout=25.0):
+    """Wave fired, every claim Ready again, no preemption notice left."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        ok = policy.injected_total("spot_preempt:") >= 1
+        if ok:
+            for name in names:
+                try:
+                    nc = await env.client.get(NodeClaim, name)
+                except NotFoundError:
+                    ok = False
+                    break
+                if not nc.status_conditions.is_true(CONDITION_READY):
+                    ok = False
+                    break
+        if ok:
+            nodes = await env.client.list(Node)
+            if any(c.type == SPOT_PREEMPTED and c.status == "True"
+                   for n in nodes for c in n.status.conditions):
+                ok = False
+        if ok:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(
+                f"spot wave never recovered: injected="
+                f"{policy.injected_total('spot_preempt:')}")
+        await asyncio.sleep(0.05)
+
+
+@async_test
+async def test_spot_reclaim_wave_replaced_within_repair_budget():
+    """The spot_reclaim profile preempts every spot slice during its wave:
+    the SpotPreempted repair path (tight spot toleration) replaces the
+    claims, replacements created after the wave closes survive, the
+    mass-delete breaker never trips, and on-demand neighbors are never
+    touched."""
+    policy = chaos.profile("spot_reclaim", seed=SEED)
+    spot_names = ["sp0", "sp1"]
+    od_names = ["od0", "od1"]
+    started0 = REPAIR_STATS["started"]
+    throttled0 = REPAIR_STATS["throttled"]
+    preempt0 = placement.SPOT_PREEMPTIONS.get(ZONE_B, 0)
+    ctr_preempt0 = SPOT_PREEMPTIONS_TOTAL.labels(ZONE_B)._value.get()
+    replacer = None
+    async with chaos_env(policy, launch_timeout=20.0,
+                         repair_toleration=0.2,
+                         spot_reclaim_grace=1.0) as env:
+        try:
+            for n in od_names:
+                await env.client.create(make_nodeclaim(n))
+            for n in spot_names:
+                await env.client.create(spot_claim(n))
+            builders = {n: spot_claim for n in spot_names}
+            builders.update({n: make_nodeclaim for n in od_names})
+            replacer, counts = _start_replacer(env, builders)
+            await _wait_wave_recovered(env, policy, spot_names + od_names)
+            # spot pools really are spot-tier (the preemption sweep's gate)
+            for n in spot_names:
+                assert env.cloud.nodepools.pools[n].config.spot
+            # repair replaced at least one preempted slice; never throttled
+            assert REPAIR_STATS["started"] > started0, \
+                "preemption notice never reached the repair path"
+            assert REPAIR_STATS["throttled"] == throttled0, \
+                "breaker/budget tripped on an uncorrelated spot wave"
+            # on-demand claims rode out the wave untouched
+            assert all(counts[n] == 0 for n in od_names), dict(counts)
+            assert any(counts[n] > 0 for n in spot_names), \
+                "no spot claim was ever replaced"
+        finally:
+            if replacer is not None:
+                replacer.cancel()
+        await assert_no_leaks_and_drained(
+            env, set(spot_names + od_names))
+        update_runtime_gauges(env.manager)
+    assert placement.SPOT_PREEMPTIONS.get(ZONE_B, 0) > preempt0
+    assert SPOT_PREEMPTIONS_TOTAL.labels(ZONE_B)._value.get() > ctr_preempt0
+
+
+# ------------------------------------------------- crash × fallback matrix
+
+@pytest.mark.parametrize("point", ["after_pool_begin_create",
+                                   "before_lro_done"])
+@async_test
+async def test_stockout_crash_resumes_walk_without_duplicate_pool(point):
+    """Die mid-fallback (the preferred zone already verdicted dry, the
+    fallback create in flight): restart must resume the walk at the right
+    candidate — the durable attempt annotation skips the dry zone without a
+    re-probe, and conflict adoption resumes the in-flight create instead of
+    double-creating."""
+    crashes = chaos.CrashPoints(at=point, seed=SEED)
+    zones = {ZONE_A: {"v5e": 0},       # dry from the start
+             ZONE_C: {"v5e": 64}}
+    opts = EnvtestOptions(gc_interval=0.1, leak_grace=0.1, zones=zones,
+                          stockout_memo_ttl=30.0, crashes=crashes)
+    opts.lifecycle.launch_timeout = 20.0
+    opts.lifecycle.registration_timeout = 20.0
+    renv = RestartableEnv(opts)
+    await renv.start()
+    try:
+        await renv.client.create(make_nodeclaim("cr0"))
+        await asyncio.wait_for(crashes.crashed.wait(), 15)
+        assert crashes.last == (point, "cr0")
+        nc = await renv.client.get(NodeClaim, "cr0")
+        attempts = nc.metadata.annotations.get(
+            PLACEMENT_ATTEMPTS_ANNOTATION, "")
+        assert f"{ZONE_A}/tpu-v5e-8/{wk.CAPACITY_TYPE_ON_DEMAND}" in attempts
+        probes_a = renv.cloud.nodepools.calls[f"begin_create:{ZONE_A}"]
+        assert probes_a == 1
+
+        await renv.restart()
+        nc = await renv.wait_ready("cr0", timeout=25)
+        assert nc.status.provider_id
+        # exactly one pool, landed in the fallback zone
+        assert set(renv.cloud.nodepools.pools) == {"cr0"}
+        pool = renv.cloud.nodepools.pools["cr0"]
+        assert pool.config.labels[wk.ZONE_LABEL] == ZONE_C
+        # the verdicted zone was never re-probed (annotation, not memo — the
+        # restarted incarnation's memo starts empty), and the fallback zone
+        # saw ONE placement probe: the resume adopted via 409, which the
+        # fake deliberately does not count as a probe
+        assert renv.cloud.nodepools.calls[f"begin_create:{ZONE_A}"] == probes_a
+        assert renv.cloud.nodepools.calls[f"begin_create:{ZONE_C}"] == 1
+        assert renv.incarnations == 2
+    finally:
+        await renv.crash()
+
+
+# ------------------------------------------------- zero capacity anywhere
+
+@async_test
+async def test_zero_capacity_everywhere_is_terminal_with_event():
+    """Exhausted across EVERY candidate: the claim gets the terminal
+    ``CreateError(reason=Stockout)`` treatment — Warning Event, claim
+    deleted, nothing leaked — and a follower inside the memo TTL terminates
+    at ZERO additional cloud probes."""
+    zones = {ZONE_A: {"v5e": 0}, ZONE_C: {"v5e": 0}}
+    async with chaos_env(None, launch_timeout=10.0, zones=zones,
+                         stockout_memo_ttl=30.0) as env:
+        await env.client.create(make_nodeclaim("zc0"))
+        await env.wait_gone("zc0", timeout=10)
+        events = await env.client.list(Event)
+        assert any(e.reason == REASON_STOCKOUT for e in events), \
+            [e.reason for e in events]
+        probes = {z: env.cloud.nodepools.calls[f"begin_create:{z}"]
+                  for z in zones}
+        assert probes == {ZONE_A: 1, ZONE_C: 1}, probes
+        # follower: both zones memo-suppressed — terminal without a probe
+        await env.client.create(make_nodeclaim("zc1"))
+        await env.wait_gone("zc1", timeout=10)
+        for z, n in probes.items():
+            assert env.cloud.nodepools.calls[f"begin_create:{z}"] == n, \
+                f"memo failed to suppress a re-probe of {z}"
+        await assert_no_leaks_and_drained(env, set())

@@ -42,6 +42,7 @@ CASES = [
     ("PL010", "pl010", {ROLE_TESTS}, 1),
     ("PL011", "pl011", {ROLE_TESTS}, 1),
     ("PL012", "pl012", {ROLE_PACKAGE}, 2),
+    ("PL013", "pl013", {ROLE_PACKAGE}, 3),
 ]
 
 
