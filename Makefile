@@ -80,8 +80,17 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09 claimtrace gates
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11 gates
+	$(PY) -m bench.bench_megawave --gate
 	$(PY) -m bench.bench_provision
+
+.PHONY: megawave
+megawave: ## Mega-wave smoke: reference gates + a 1k-claim 8-shard wave (full 10k tier: make megawave-full)
+	$(PY) -m bench.bench_megawave --gate
+
+.PHONY: megawave-full
+megawave-full: ## Full mega-wave tier: 10k claims at shard counts 1/4/8; slow — minutes of wall
+	$(PY) -m bench.bench_megawave --full
 
 .PHONY: trace
 trace: ## 100-claim wave under claimtrace; print the critical-path attribution summary

@@ -550,6 +550,61 @@ def check_literal_error_reason(ctx: RuleContext) -> list[tuple[int, str]]:
     return out
 
 
+# ---------------------------------------------- PL014 unsourced-requeue-wait
+
+_WAKES_RE = re.compile(r"#\s*wakes:\s*\S")
+
+
+def _is_requeue_result(call: ast.Call, ctx: RuleContext) -> bool:
+    d = ctx.resolved(call.func) or dotted_name(call.func) or ""
+    if d.rsplit(".", 1)[-1] != "Result":
+        return False
+    for kw in call.keywords:
+        if kw.arg == "requeue_after":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def _uses_wakehub(fn: ast.AST) -> bool:
+    for n in body_walk(fn):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("wake", "wake_after")):
+            return True
+    return False
+
+
+def check_unsourced_requeue_wait(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in ctx.functions():
+        armed = _uses_wakehub(fn)
+        for node in body_walk(fn):
+            if not (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Call)
+                    and _is_requeue_result(node.value, ctx)):
+                continue
+            if armed:
+                continue  # the function itself arms a WakeHub wake
+            # the return's own lines, plus the contiguous comment block
+            # directly above it (annotations often share a longer comment)
+            window = list(ctx.lines[node.lineno - 1:
+                                    (node.end_lineno or node.lineno)])
+            i = node.lineno - 2
+            while i >= 0 and ctx.lines[i].lstrip().startswith("#"):
+                window.append(ctx.lines[i])
+                i -= 1
+            if any(_WAKES_RE.search(line) for line in window):
+                continue
+            out.append((node.lineno, (
+                "Result(requeue_after=...) without a declared wake source — "
+                "annotate the return with `# wakes: <source>` (node / lro / "
+                "timer / stockout / ...) or arm a WakeHub wake in the same "
+                "function; an undeclared wait is exactly the requeue-idle-"
+                "gap the event-driven control plane exists to kill (the "
+                "timer must be the named safety net, not an accident)")))
+    return out
+
+
 # ----------------------------------------------------------------- catalog
 
 RULES: list[Rule] = [
@@ -607,4 +662,9 @@ RULES: list[Rule] = [
          "the errors.py reason enum, never string literals at call sites "
          "(PR 10 capacity placement: a drifted literal flips a terminal "
          "fault into an infinite retry)", check_literal_error_reason),
+    Rule("PL014", "unsourced-requeue-wait", frozenset({ROLE_CONTROLLERS}),
+         "every controller Result(requeue_after=...) names its wake source "
+         "— a `# wakes: <source>` annotation or an in-function WakeHub wake "
+         "(PR 11 event-driven control plane: the timer is the safety net, "
+         "never the undeclared primary)", check_unsourced_requeue_wait),
 ]

@@ -294,18 +294,21 @@ class NodeHealthController:
         if rep is not None and rep.deleted:
             return Result()  # claim delete issued; waiting out the node GC
         if not diag.due:
+            # wakes: timer — waiting out the toleration deadline itself
             return Result(requeue_after=max(0.02, diag.requeue_after))
 
         if self._cache_too_stale():
             log.warning("repair of %s deferred: cached cluster view older "
                         "than %.0fs", node.metadata.name,
                         self.opts.max_cache_age)
+            # wakes: timer — cache freshness recovers on its own clock
             return Result(requeue_after=self.opts.throttle_requeue)
 
         if await self._circuit_broken(mono):
             REPAIR_STATS["throttled"] += 1
             log.warning("repair of %s skipped: cluster unhealthy fraction "
                         "over limit", node.metadata.name)
+            # wakes: timer — breaker TTL expiry, no event to subscribe to
             return Result(requeue_after=self.opts.throttle_requeue)
 
         nc = await nodeclaim_for_node(self.client, node)
@@ -323,6 +326,7 @@ class NodeHealthController:
             if why is not None:
                 REPAIR_STATS["throttled"] += 1
                 log.info("repair of %s throttled: %s", req.name, why)
+                # wakes: timer — budget tokens refill on the rate interval
                 return Result(requeue_after=self.opts.throttle_requeue)
             rep = _Repair(
                 group=self._group_key(node), started=mono,
@@ -357,6 +361,7 @@ class NodeHealthController:
         # or cloud-invisible; the force-delete has not been issued
         self._crash("mid_repair", req.name)
         if not drained and not rep.ladder.expired():
+            # wakes: timer — drain-ladder backoff; evictions emit no event
             return Result(requeue_after=rep.ladder.next_delay())
 
         log.info("repairing node %s: %s; %sdeleting nodeclaim %s",
@@ -381,6 +386,8 @@ class NodeHealthController:
         # a silently dead kubelet emits NO events — with the heartbeat bound
         # enabled, healthy nodes are re-polled so staleness is ever observed
         if self.opts.heartbeat_bound > 0:
+            # wakes: timer — a silently dead kubelet emits nothing; polling
+            # at half the bound is the only way staleness is ever observed
             return Result(requeue_after=max(0.05, self.opts.heartbeat_bound / 2))
         return Result()
 

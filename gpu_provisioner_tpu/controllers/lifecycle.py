@@ -41,7 +41,8 @@ from ..errors import (
 )
 from ..providers.operations import loop_now
 from ..runtime import NotFoundError, Request, Result
-from ..runtime.client import Client, ConflictError, patch_retry
+from ..runtime.client import Client, patch_retry
+from .statusbatch import write_claim_patches
 from ..runtime.events import Recorder
 from ..scheduling import merge_taints, remove_taint
 from .metrics import (
@@ -69,6 +70,10 @@ class LifecycleOptions:
     # workqueue the tick its operation completes — this only bounds how
     # long a claim can sit if that injection is ever missed.
     inprogress_requeue: float = 5.0
+    # StatusWriteBatcher flush window (seconds). Read by the boot path /
+    # envtest when constructing the batcher; 0 disables batching (every
+    # _flush_status writes directly, the pre-batcher behavior).
+    status_flush_window: float = 0.05
 
 
 @dataclass
@@ -81,7 +86,8 @@ class NodeClaimLifecycleController:
     NAME = "nodeclaim.lifecycle"
 
     def __init__(self, client: Client, cloudprovider, recorder: Optional[Recorder] = None,
-                 options: Optional[LifecycleOptions] = None, tracer=None):
+                 options: Optional[LifecycleOptions] = None, tracer=None,
+                 status_batcher=None):
         self.client = client
         self.cp = cloudprovider
         self.recorder = recorder
@@ -89,6 +95,9 @@ class NodeClaimLifecycleController:
         # the launched/registered/ready annotations the critical-path
         # analyzer keys off.
         self.tracer = tracer
+        # StatusWriteBatcher (optional): _flush_status submits into its
+        # window instead of writing; None = direct writes (tests, window=0).
+        self.batcher = status_batcher
         self.opts = options or LifecycleOptions()
         # Launch idempotence cache by UID: survives duplicate reconciles when
         # the status write raced (launch.go:64-74).
@@ -116,6 +125,11 @@ class NodeClaimLifecycleController:
             return Result()
         if not is_managed(nc):
             return Result()
+        if self.batcher is not None:
+            # Read-your-batched-writes: a reconcile inside the flush window
+            # must see its predecessor's (still pending) status or it will
+            # redo sub-reconciler work against pre-batch conditions.
+            nc = self.batcher.overlay(nc)
         if self.tracer is not None:
             attrs = {"uid": nc.metadata.uid}
             group = nc.metadata.labels.get(wk.TPU_SLICE_GROUP_LABEL)
@@ -158,50 +172,25 @@ class NodeClaimLifecycleController:
         await self._flush_status(nc)
         if error is not None:
             raise error
+        # wakes: aggregate — min of the sub-reconcilers' annotated waits
         return Result(requeue_after=min(requeues) if requeues else None,
                       preserve_failures=preserve)
 
-    async def _flush_status(self, nc: NodeClaim) -> None:
-        def copy_status(obj):
-            # No-op writes would bump resourceVersion → watch event → another
-            # reconcile: a self-sustaining hot loop on steady-state claims.
-            # Dataclass == (recursive, allocation-free) — both statuses are
-            # same-class in-memory trees; serializing them to dicts first
-            # was the top steady-state CPU cost at 1024 claims (~20% of
-            # busy time profiled).
-            if obj.status == nc.status:
-                return False
-            obj.status = nc.status
-
-        def copy_meta(obj):
-            # Additive merge, NEVER wholesale replace: a concurrent reconcile
-            # whose snapshot predates the launch label-merge would otherwise
-            # clobber the labels launch just flushed (found as a real lost
-            # update — claim Ready without its topology labels — since
-            # _launch early-returns once Launched and never re-merges).
-            changed = False
-            for k, v in nc.metadata.labels.items():
-                if obj.metadata.labels.get(k) != v:
-                    obj.metadata.labels[k] = v
-                    changed = True
-            for k, v in nc.metadata.annotations.items():
-                if obj.metadata.annotations.get(k) != v:
-                    obj.metadata.annotations[k] = v
-                    changed = True
-            return None if changed else False
-        try:
-            with self._span(nc.metadata.name, "status-write"):
-                # Meta BEFORE status: conditions (incl. Ready) must never be
-                # observable while the launch-merged labels are still
-                # unwritten — a reader acting on Ready would see a claim
-                # without its topology labels, and _launch never re-merges
-                # once Launched persists.
-                await patch_retry(self.client, NodeClaim, nc.metadata.name,
-                                  copy_meta)
-                await patch_retry(self.client, NodeClaim, nc.metadata.name,
-                                  copy_status, status=True)
-        except ConflictError:
-            pass  # next reconcile sees fresh state
+    async def _flush_status(self, nc: NodeClaim, direct: bool = False) -> None:
+        """Persist ``nc``'s meta+status. With a batcher, submit into its
+        flush window (latest-wins coalescing); ``direct=True`` bypasses the
+        window — used by terminal paths that delete the claim immediately
+        after, where a delayed flush would race the delete — and drops any
+        pending snapshot so a stale batch cannot land after the direct
+        write. The write itself (no-op suppression, additive meta merge,
+        meta-before-status ordering) lives in
+        ``statusbatch.write_claim_patches``, shared with the batcher."""
+        if self.batcher is not None:
+            if not direct:
+                await self.batcher.submit(nc)
+                return
+            self.batcher.drop(nc.metadata.name)
+        await write_claim_patches(self.client, nc, tracer=self.tracer)
 
     # --------------------------------------------------------------- launch
     async def _launch(self, nc: NodeClaim) -> Optional[Result]:
@@ -222,7 +211,7 @@ class NodeClaimLifecycleController:
                             nc.metadata.name, e)
                 await self._publish(nc, "Warning", type(e).__name__, str(e))
                 cs.set_false(LAUNCHED, type(e).__name__, str(e))
-                await self._flush_status(nc)
+                await self._flush_status(nc, direct=True)
                 try:
                     await self.client.delete(NodeClaim, nc.metadata.name)
                 except NotFoundError:
@@ -239,7 +228,7 @@ class NodeClaimLifecycleController:
                     log.warning("nodeclaim %s launch terminal failure (%s): %s",
                                 nc.metadata.name, e.reason, e)
                     await self._publish(nc, "Warning", e.reason, str(e))
-                    await self._flush_status(nc)
+                    await self._flush_status(nc, direct=True)
                     try:
                         await self.client.delete(NodeClaim, nc.metadata.name)
                     except NotFoundError:
@@ -256,6 +245,7 @@ class NodeClaimLifecycleController:
                     # ERROR alternates fail→re-register, and wiping the
                     # counter each lap would pin its retry cadence flat
                     # instead of climbing the ladder.
+                    # wakes: lro — tracker completion via the WakeHub
                     return Result(requeue_after=self.opts.inprogress_requeue,
                                   preserve_failures=True)
                 # Other transient reasons (NodesNotReady, QueuedProvisioning)
@@ -293,6 +283,7 @@ class NodeClaimLifecycleController:
         if len(nodes) < hosts:
             cs.set_false(REGISTERED, "AwaitingNodes",
                          f"{len(nodes)}/{hosts} slice nodes present")
+            # wakes: node — Node watch source wakes the claim on arrival
             return Result(requeue_after=self.opts.registration_requeue)
 
         for node in nodes:
@@ -351,6 +342,7 @@ class NodeClaimLifecycleController:
         if len(nodes) < hosts or not_ready:
             cs.set_false(INITIALIZED, "NodesNotReady",
                          f"waiting on {not_ready or 'missing nodes'}")
+            # wakes: node — readiness flips arrive on the Node watch
             return Result(requeue_after=self.opts.registration_requeue)
 
         startup_tainted = [n.metadata.name for n in nodes
@@ -358,6 +350,7 @@ class NodeClaimLifecycleController:
         if startup_tainted:
             cs.set_false(INITIALIZED, "StartupTaintsPresent",
                          f"startup taints on {startup_tainted}")
+            # wakes: node — taint removal arrives on the Node watch
             return Result(requeue_after=self.opts.registration_requeue)
 
         missing = [n.metadata.name for n in nodes if not _tpu_registered(n)]
@@ -366,6 +359,7 @@ class NodeClaimLifecycleController:
             # of waiting for nvidia.com/gpu (initialization.go:119-134).
             cs.set_false(INITIALIZED, "ResourcesNotRegistered",
                          f"google.com/tpu not registered on {missing}")
+            # wakes: node — device-plugin registration is a Node update
             return Result(requeue_after=self.opts.registration_requeue)
 
         cs.set_true(INITIALIZED, "Initialized")
@@ -412,6 +406,8 @@ class NodeClaimLifecycleController:
             except NotFoundError:
                 pass
             return None
+        # wakes: timer — a liveness deadline IS the timer; nothing else
+        # can end this wait early (progress cancels it via the other subs)
         return Result(requeue_after=max(1.0, budget - age))
 
     # ------------------------------------------------------------- finalize
@@ -440,6 +436,7 @@ class NodeClaimLifecycleController:
             changed = cs.set_true(INSTANCE_TERMINATING, "InstanceTerminating")
             if changed:
                 await self._flush_status(nc)
+            # wakes: lro — the queued cloud delete completes via the tracker
             return Result(requeue_after=self.opts.termination_requeue)
         except NodeClaimNotFoundError:
             pass  # instance gone
@@ -447,6 +444,7 @@ class NodeClaimLifecycleController:
         # Hold the finalizer until the slice's Node objects are fully gone so
         # nodeclaim_for_node keeps resolving during node teardown.
         if await slice_nodes(self.client, nc.metadata.name):
+            # wakes: node — node deletion events arrive on the Node watch
             return Result(requeue_after=min(1.0, self.opts.termination_requeue))
 
         def drop_finalizer(obj):

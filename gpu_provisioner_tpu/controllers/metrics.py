@@ -103,6 +103,25 @@ WORKQUEUE_REQUEUES = _get_or_create(
     "Cumulative rate-limited requeues (sampled from the queue counter).",
     ["controller"])
 
+SHARD_QUEUE_DEPTH = _get_or_create(
+    Gauge, "tpu_provisioner_shard_queue_depth",
+    "Ready items summed across this process's controllers by shard index — "
+    "the shard-imbalance view (singletons and key-less requests pile onto "
+    "shard 0; see docs/PERFORMANCE.md).", ["shard"])
+
+# True Counter fed by DELTA from the runtime wakehub's module ledger at
+# scrape time (the runtime layer never imports prometheus) — the
+# STOCKOUTS_TOTAL idiom. Counts wakes that actually landed an enqueue;
+# dedup-collapsed wakes are invisible by design.
+REQUEUE_WAKES_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_requeue_wakes_total",
+    "Workqueue enqueues by wake source (watch/node/lro/timer/stockout/"
+    "status-flush/inject). 'timer' means a requeue_after safety net had to "
+    "fire — residual polling the wake graph should be eliminating.",
+    ["source"])
+
+_wakes_seen: dict[str, int] = {}
+
 # --------------------------------------------------------- crash recovery
 
 RECOVERY_ADOPTED = _get_or_create(
@@ -259,6 +278,7 @@ def update_runtime_gauges(manager) -> None:
     /metrics handler at scrape time (and by soak tests directly) — gauges
     sample state that lives in the runtime layer, which must not import
     prometheus."""
+    shard_depths: dict[int, int] = {}
     for c in getattr(manager, "controllers", []):
         q = c.queue
         WORKQUEUE_DEPTH.labels(c.name).set(q.depth())
@@ -266,6 +286,16 @@ def update_runtime_gauges(manager) -> None:
         WORKQUEUE_RETRYING.labels(c.name).set(q.retrying())
         WORKQUEUE_REQUEUES.labels(c.name).set(q.requeues_total)
         FENCED_RECONCILES.labels(c.name).set(c.fenced_total)
+        shard = getattr(c, "shard_index", 0)
+        shard_depths[shard] = shard_depths.get(shard, 0) + q.depth()
+    for shard, depth in shard_depths.items():
+        SHARD_QUEUE_DEPTH.labels(str(shard)).set(depth)
+    from ..runtime import wakehub as _wakehub
+    for source, n in list(_wakehub.WAKES.items()):
+        delta = n - _wakes_seen.get(source, 0)
+        if delta > 0:
+            REQUEUE_WAKES_TOTAL.labels(source).inc(delta)
+            _wakes_seen[source] = n
     for name, stats in CACHE_STATS.items():
         for stat, gauge in _CACHE_GAUGES:
             gauge.labels(name).set(stats[stat])

@@ -18,6 +18,7 @@ from ..apis.karpenter import NodeClaim
 from ..runtime import Controller, Request, Singleton
 from ..runtime.client import Client
 from ..runtime.events import Recorder
+from ..runtime.wakehub import SOURCE_LRO, SOURCE_NODE, WakeHub
 from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
@@ -67,6 +68,8 @@ def build_controllers(client: Client, cloudprovider,
                       fence=None,
                       tracker=None,
                       tracer=None,
+                      wakehub=None,
+                      status_batcher=None,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -130,11 +133,25 @@ def build_controllers(client: Client, cloudprovider,
 
     def node_map(node: Node) -> list[Request]:
         key = _node_pool(node)
-        mine = owns(key) if key else shard_index == 0
+        # Pool-less nodes hash by their own name — routing them ALL to
+        # shard 0 (the old rule) piled every unlabeled node onto the shard
+        # that already runs both GC loops, recovery, and slice-group
+        # assignment (measured as shard_queue_depth imbalance at 10k
+        # claims). Any consistent owner works: these requests are keyed by
+        # node name end to end, so no cross-shard correlation exists to
+        # preserve.
+        mine = owns(key) if key else owns(node.metadata.name)
         return [Request(name=node.metadata.name)] if mine else []
 
+    # The wake graph: out-of-band completion sources (LRO resolution, the
+    # status batcher's flush) fan into lifecycle's workqueue through the
+    # hub; callers that pass their own hub (envtest, __main__) share it
+    # with the provider's stockout parking.
+    if wakehub is None:
+        wakehub = WakeHub()
     lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
-                                            lifecycle_options, tracer=tracer)
+                                            lifecycle_options, tracer=tracer,
+                                            status_batcher=status_batcher)
     eviction = EvictionQueue(client, recorder=recorder)
     termination = NodeTerminationController(client, cloudprovider, eviction,
                                             recorder, termination_options,
@@ -146,10 +163,15 @@ def build_controllers(client: Client, cloudprovider,
         Controller(lifecycle.NAME, lifecycle,
                    max_concurrent=max_concurrent_reconciles, **hardening)
         .watches(NodeClaim, map_fn=claim_map)
-        .watches(Node, map_fn=node_claim_map))
+        # Node events are wake-ups for claims parked on registration/
+        # initialization requeues — label them so idle-gap attribution
+        # (and the wakes counter) sees "node", not generic "watch".
+        .watches(Node, map_fn=node_claim_map, wake_source=SOURCE_NODE))
+    wakehub.register(lifecycle_controller.inject)
     if tracker is not None:
-        # early wake: tracked-operation completion → lifecycle workqueue
-        tracker.subscribe(lambda op: lifecycle_controller.inject(op.name))
+        # early wake: tracked-operation completion → hub → lifecycle
+        # workqueue, labeled "lro" for attribution
+        tracker.subscribe(lambda op: wakehub.wake(op.name, SOURCE_LRO))
     if tracker is not None and tracer is not None:
         tracker.subscribe(lambda op: _record_operation_spans(tracer, op))
     controllers = [
@@ -193,13 +215,15 @@ def build_controllers(client: Client, cloudprovider,
     exhausted_hook = _make_exhausted_hook(client, recorder)
     trace_seam = None
     if tracer is not None:
-        trace_seam = (lambda name, req, queue_wait:
+        trace_seam = (lambda name, req, queue_wait, wake_source=None:
                       tracer.reconcile_span(name, req.name,
-                                            queue_wait=queue_wait))
+                                            queue_wait=queue_wait,
+                                            wake_source=wake_source))
     for c in controllers:
         c.set_metrics_hook(_reconcile_metrics_hook)
         c.set_exhausted_hook(exhausted_hook)
         c.fence = fence
+        c.shard_index = shard_index  # labels the shard queue-depth gauge
         # singletons reconcile a synthetic tick, not a claim — tracing
         # them would grow one junk trace per singleton name
         if trace_seam is not None and not c.singleton:

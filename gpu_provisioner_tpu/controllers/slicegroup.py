@@ -112,4 +112,5 @@ class SliceGroupController:
 
         # periodic resync guards against missed watch events (group members
         # appear via pool joins the Node watch does see, but cheap insurance)
+        # wakes: node — watch-driven; this resync timer is the insurance
         return Result(requeue_after=self.resync)

@@ -1,0 +1,289 @@
+"""StatusWriteBatcher: coalesce per-claim meta+status patches.
+
+BENCH_pr02 flagged the per-claim patch storm (906 ``nodepools.get``-era
+call profile); every lifecycle reconcile ends in ``_flush_status`` — up to
+two writes per lap, each bumping resourceVersion and fanning a watch event
+back into every shard's pump. During a wave a claim reconciles many times
+in quick succession (launch, registration laps, initialization laps), and
+only the LAST state matters to any reader: coalescing those writes inside
+a short flush window cuts both the kube-call volume and the self-inflicted
+watch-event churn out of the wave hot path.
+
+Semantics, in priority order:
+
+- **Latest-wins per claim.** ``submit`` replaces any pending snapshot for
+  the same claim; the flush writes one meta patch + one status patch per
+  claim per window, maximum.
+- **Meta before status.** The same invariant ``_flush_status`` documents:
+  Ready must never be observable while launch-merged labels are unwritten.
+  Preserved per claim because the flush calls :func:`write_claim_patches`,
+  which orders the two patches, not because of batch ordering.
+- **Fence-checked at flush.** Acceptance into the batch is cheap and
+  unfenced; the fence (assigned post-election, like the provider's) is
+  checked when the batch actually writes. A deposed leader drops its
+  pending batch on the floor — the new leader's reconciles rebuild the
+  same status from fresh state, exactly like the worker-level fence drop.
+- **Self-clocking window.** The next flush window stretches to the last
+  flush's duration (capped at ``max_window``, group-commit style): a
+  small fleet's ms flushes leave the base window untouched, a mega-wave
+  backlog whose flush takes seconds widens the window so the condition
+  cascade (Registered → Initialized → Ready) coalesces instead of
+  writing once per lap.
+- **Crash-adoptable.** Pending snapshots live only in this process; a
+  crash between accept and flush simply loses them. That is safe by the
+  same argument as the fence drop: status is *derived* state — recovery
+  adoption re-reconciles every claim from the store + cloud truth and
+  re-materializes whatever the lost flush would have written.
+
+Direct writes remain available for paths that must not race a delayed
+flush (terminal failures that delete the claim right after writing):
+``lifecycle._flush_status(nc, direct=True)`` drops any pending snapshot
+and writes synchronously through the same helper.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import copy
+import logging
+from typing import Optional
+
+from ..apis.karpenter import NodeClaim
+from ..runtime import NotFoundError
+from ..runtime.client import Client, ConflictError, patch_retry
+from ..runtime.wakehub import SOURCE_STATUS_FLUSH
+
+log = logging.getLogger("controllers.statusbatch")
+
+
+async def write_claim_patches(client: Client, nc: NodeClaim,
+                              tracer=None) -> bool:
+    """Write ``nc``'s meta (additive label/annotation merge) then status
+    onto the stored claim; returns True if either patch actually wrote.
+
+    This is ``lifecycle._flush_status``'s write path, extracted so the
+    batcher and the direct path share one implementation of the two
+    load-bearing invariants: no-op suppression (a no-op write would bump
+    resourceVersion → watch event → another reconcile, a self-sustaining
+    hot loop) and meta-before-status ordering (conditions, incl. Ready,
+    must never be observable while launch-merged labels are unwritten —
+    ``_launch`` never re-merges once Launched persists).
+    """
+    wrote = {"any": False}
+
+    def copy_status(obj):
+        if obj.status == nc.status:
+            return False
+        obj.status = nc.status
+        wrote["any"] = True
+
+    def copy_meta(obj):
+        # Additive merge, NEVER wholesale replace: a concurrent reconcile
+        # whose snapshot predates the launch label-merge must not clobber
+        # the labels launch just flushed (a real lost update — claim Ready
+        # without its topology labels).
+        changed = False
+        for k, v in nc.metadata.labels.items():
+            if obj.metadata.labels.get(k) != v:
+                obj.metadata.labels[k] = v
+                changed = True
+        for k, v in nc.metadata.annotations.items():
+            if obj.metadata.annotations.get(k) != v:
+                obj.metadata.annotations[k] = v
+                changed = True
+        if changed:
+            wrote["any"] = True
+        return None if changed else False
+
+    span = (tracer.span(nc.metadata.name, "status-write")
+            if tracer is not None else contextlib.nullcontext())
+    try:
+        with span:
+            await patch_retry(client, NodeClaim, nc.metadata.name, copy_meta)
+            await patch_retry(client, NodeClaim, nc.metadata.name,
+                              copy_status, status=True)
+    except ConflictError:
+        pass  # next reconcile sees fresh state
+    return wrote["any"]
+
+
+class StatusWriteBatcher:
+    """Window-coalescing writer for NodeClaim meta+status patches.
+
+    One background task; wake-on-submit then sleep ``window`` so a wave's
+    burst of submits for the same claim collapses into one write. Started
+    and stopped by the boot path / envtest alongside the tracker (the
+    envtest leak gate enumerates ``_task``).
+    """
+
+    def __init__(self, client: Client, window: float = 0.05, fence=None,
+                 tracer=None, wakehub=None, max_window: float = 1.0):
+        self.client = client
+        self.window = window
+        # Self-clocking ceiling (group-commit style): the NEXT window
+        # stretches to the duration of the LAST flush, capped here. A small
+        # fleet's ms flushes never move it; a 10k-claim backlog whose flush
+        # takes seconds widens the window so a claim's Registered →
+        # Initialized → Ready cascade coalesces into one write instead of
+        # three. The cost is bounded extra status latency under exactly the
+        # load where per-write churn hurts most.
+        self.max_window = max_window
+        self._last_flush_s = 0.0
+        # Like the provider/controller fences: assigned post-election by
+        # the boot path; None means unfenced (tests, single-process).
+        self.fence = fence
+        self.tracer = tracer
+        self.wakehub = wakehub
+        self._pending: dict[str, NodeClaim] = {}
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.submitted = 0
+        self.coalesced = 0
+        self.flushes = 0
+        self.fence_dropped = 0
+        self.writes = 0
+        self.retried = 0
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="status-batcher")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        # Final drain: flush whatever was accepted but not yet written so a
+        # clean shutdown loses nothing (a crash legitimately does — see the
+        # module docstring's crash-adoptable contract). Bounded retries:
+        # a transient write error leaves its entry pending, and with the
+        # run task gone nothing else would drain it.
+        for _ in range(3):
+            if not self._pending:
+                break
+            await self._flush_round()
+
+    async def submit(self, nc: NodeClaim) -> None:
+        """Accept a claim snapshot for the next flush window; latest wins."""
+        self.submitted += 1
+        if nc.metadata.name in self._pending:
+            self.coalesced += 1
+        self._pending[nc.metadata.name] = nc
+        self._wake.set()
+
+    def drop(self, name: str) -> None:
+        """Forget any pending snapshot for ``name`` — the direct-write path
+        calls this first so a stale batched flush cannot land AFTER the
+        synchronous write it bypassed the window for."""
+        self._pending.pop(name, None)
+
+    def overlay(self, obj: NodeClaim) -> NodeClaim:
+        """Read-your-batched-writes: apply the pending snapshot for this
+        claim onto a fresh GET. Without this, a reconcile inside the flush
+        window would see pre-batch status (e.g. Launched not yet True) and
+        redo work — the ``_launched`` UID cache backstops launch, but
+        every sub-reconciler would churn. Spec and deletion_timestamp stay
+        the GET's own (the batcher never owns those); the status is
+        deep-copied so the reconcile's mutations don't alias the pending
+        snapshot mid-flush."""
+        pend = self._pending.get(obj.metadata.name)
+        if pend is None:
+            return obj
+        for k, v in pend.metadata.labels.items():
+            obj.metadata.labels[k] = v
+        for k, v in pend.metadata.annotations.items():
+            obj.metadata.annotations[k] = v
+        obj.status = copy.deepcopy(pend.status)
+        return obj
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def _next_window(self) -> float:
+        """Base window, stretched to the last flush's duration (capped at
+        ``max_window``) — flush cost is the load signal."""
+        return max(self.window, min(self._last_flush_s, self.max_window))
+
+    async def _run(self) -> None:
+        while True:
+            await self._wake.wait()
+            await asyncio.sleep(self._next_window())
+            # Clear BEFORE draining: a submit that lands during the flush
+            # re-arms the event and gets the NEXT window, never lost.
+            self._wake.clear()
+            if not self._pending:
+                continue
+            await self._flush_round()
+
+    async def _flush_round(self) -> None:
+        """Flush a snapshot view of the pending map, WITHOUT popping it
+        first: a flush under load runs for seconds, and a reconcile landing
+        mid-flush must still see its claim through ``overlay()`` — popping
+        up front blinded it, so that reconcile re-derived conditions from
+        the stale store and re-stamped their lastTransitionTimes, a
+        spurious extra status write per claim per flush-race. Entries are
+        removed only after they flush, and only if no newer submit
+        superseded them (latest-wins holds throughout)."""
+        batch = dict(self._pending)
+        done = await self._flush(batch)
+        for name in done:
+            if self._pending.get(name) is batch[name]:
+                self._pending.pop(name)
+
+    async def _flush(self, batch: dict[str, NodeClaim]) -> set[str]:
+        """Write every snapshot in ``batch``; returns the names that are
+        DONE (written, no-op, deleted, or fence-dropped). Names that hit a
+        transient error are excluded — their entries stay pending and the
+        re-armed wake retries them next window."""
+        self.flushes += 1
+        t0 = asyncio.get_event_loop().time()
+        try:
+            return await self._flush_inner(batch)
+        finally:
+            self._last_flush_s = asyncio.get_event_loop().time() - t0
+
+    async def _flush_inner(self, batch: dict[str, NodeClaim]) -> set[str]:
+        if self.fence is not None and not self.fence.valid():
+            # Deposed: the new leader's reconciles own status now. Dropping
+            # is correct for the same reason the worker fence drop is.
+            self.fence_dropped += len(batch)
+            return set(batch)
+        sem = asyncio.Semaphore(64)
+        done: set[str] = set()
+
+        async def one(nc: NodeClaim) -> None:
+            async with sem:
+                try:
+                    changed = await write_claim_patches(self.client, nc,
+                                                        tracer=self.tracer)
+                except NotFoundError:
+                    done.add(nc.metadata.name)  # claim deleted since accept
+                    return
+                except Exception:
+                    # Transient apiserver error (e.g. chaos-injected 5xx).
+                    # The inline path got retries for free — the error
+                    # propagated out of reconcile and the controller
+                    # requeued with backoff. The batcher has no reconcile
+                    # to lean on, so its entry stays pending and the next
+                    # window retries it (latest-wins: a newer submit
+                    # supersedes the failed snapshot). Crucially the
+                    # batcher task must NOT die: one dropped flush loses a
+                    # write, a dead batcher loses them all.
+                    log.warning("status flush for %s failed; retrying "
+                                "next window", nc.metadata.name,
+                                exc_info=True)
+                    self.retried += 1
+                    self._wake.set()
+                    return
+                done.add(nc.metadata.name)
+                if changed:
+                    self.writes += 1
+                    if self.wakehub is not None:
+                        await self.wakehub.wake(nc.metadata.name,
+                                                SOURCE_STATUS_FLUSH)
+
+        await asyncio.gather(*(one(nc) for nc in batch.values()))
+        return done

@@ -237,18 +237,22 @@ class NodeTerminationController:
                 if nc is not None:
                     await self._set_cond(nc, DRAINED, drained, "Draining")
                 if not drained:
+                    # wakes: timer — eviction progress has no watch event
                     return Result(requeue_after=self.opts.requeue)
 
                 detached = await self._volumes_detached(node)
                 if nc is not None:
                     await self._set_cond(nc, VOLUMES_DETACHED, detached, "AwaitingDetach")
                 if not detached and not self._detach_timed_out(node):
+                    # wakes: timer — volume detach is polled, not watched
                     return Result(requeue_after=self.opts.requeue)
 
             # Grace expiry abandons the drain, never the instance wait: the
             # finalizer must not drop while the TPU VM is alive or the kubelet
             # re-registers the Node. NodeClaim finalize drives the delete.
             if not await self._instance_gone(node):
+                # wakes: timer — the delete LRO wakes the claim's finalize
+                # (lro), not this Node-keyed wait; the poll is the primary
                 return Result(requeue_after=self.opts.instance_requeue)
 
         def drop(obj: Node):

@@ -23,12 +23,14 @@ from .controllers.health import HealthOptions
 from .controllers.lifecycle import LifecycleOptions
 from .controllers.recovery import RecoveryOptions
 from .controllers.registry import build_controllers
+from .controllers.statusbatch import StatusWriteBatcher
 from .controllers.termination import TerminationOptions
 from .fake.cloud import FakeCloud
 from .providers.instance import InstanceProvider, ProviderConfig
 from .providers.operations import OperationTracker
 from .runtime import InMemoryClient, Manager
 from .runtime.events import Recorder
+from .runtime.wakehub import WakeHub
 
 
 @dataclass
@@ -73,7 +75,7 @@ class EnvtestOptions:
     leak_grace: float = 0.2
     lifecycle: LifecycleOptions = field(default_factory=lambda: LifecycleOptions(
         termination_requeue=0.05, registration_requeue=0.05,
-        inprogress_requeue=0.1))
+        inprogress_requeue=0.1, status_flush_window=0.01))
     termination: TerminationOptions = field(default_factory=lambda: TerminationOptions(
         requeue=0.05, instance_requeue=0.05))
     # Scaled-down reference toleration (10 min → 30 s): must stay well above
@@ -226,6 +228,13 @@ class Env:
             self.tracer = Tracer(self.trace_store)
             install_log_record_factory()
             trace_ids = current_ids
+        # Event-driven wake graph (runtime/wakehub.py): one hub per Env —
+        # inject() bypasses the watch map-fns' shard filtering, so a hub
+        # shared across shard Envs would enqueue foreign claims into this
+        # shard's queue (single-writer violation). Every wake producer in
+        # this Env (tracker completions, Node watch, stockout parking,
+        # status-flush) routes through it.
+        self.wakehub = WakeHub()
         self.provider = InstanceProvider(
             self.cloud.nodepools, kube,
             ProviderConfig(
@@ -240,6 +249,18 @@ class Env:
                 spot_demote_window=self.opts.spot_demote_window),
             queued=self.cloud.queuedresources,
             crashes=self.opts.crashes, fence=fence, tracer=self.tracer)
+        # assigned post-construction, like the fence: the provider's
+        # stockout-park path arms hub timers when configured to
+        self.provider.wakehub = self.wakehub
+        # Status-write coalescing (controllers/statusbatch.py): batches the
+        # lifecycle's per-claim meta+status flushes over the same
+        # (chaos/informer-wrapped) client the controllers write with.
+        # window <= 0 keeps the legacy synchronous flush.
+        self.status_batcher = None
+        if self.opts.lifecycle.status_flush_window > 0:
+            self.status_batcher = StatusWriteBatcher(
+                kube, window=self.opts.lifecycle.status_flush_window,
+                fence=fence, tracer=self.tracer, wakehub=self.wakehub)
         self.tracker = None
         if not self.opts.blocking_create:
             # the tracker polls through the provider's COUNTED seam so its
@@ -282,8 +303,15 @@ class Env:
                 interval=self.opts.recovery_interval,
                 grace=self.opts.leak_grace),
             crashes=self.opts.crashes, fence=fence,
-            tracker=self.tracker, tracer=self.tracer)
-        self.manager = Manager(self.client).register(*controllers)
+            tracker=self.tracker, tracer=self.tracer,
+            wakehub=self.wakehub, status_batcher=self.status_batcher)
+        # The manager pumps watch through the SAME (chaos/informer-wrapped)
+        # client the controllers read from — with the informer on, events
+        # arrive via its post-cache-update relay, so a woken reconcile can
+        # never list a cache that doesn't hold the event that woke it (the
+        # real operator wires Manager(kube) identically). ChaosClient
+        # passes watch() through, so kube chaos still never gates events.
+        self.manager = Manager(kube).register(*controllers)
         # runtime detectors (analysis/detectors.py), armed in __aenter__
         self.stall = None
         self._threads_before: set = set()
@@ -317,6 +345,8 @@ class Env:
                 # hardware), not part of the operator — kube chaos must
                 # not gate its writes
                 self.opts.node_faults.start(self.client)
+            if self.status_batcher is not None:
+                self.status_batcher.start()
             self.eviction.start()
             await self.manager.start()
         except BaseException:
@@ -325,10 +355,13 @@ class Env:
             # component) or the half-born Env leaks its tasks into every
             # later test in the process: the leak gate's own bug class
             for closer in (self.manager.stop, self.eviction.stop,
+                           *((self.status_batcher.stop,)
+                             if self.status_batcher is not None else ()),
                            *((self.opts.node_faults.stop,)
                              if self.opts.node_faults is not None else ()),
                            *((self.tracker.stop,)
                              if self.tracker is not None else ()),
+                           self.wakehub.stop,
                            *((self.informers.stop,)
                              if self.informers is not None else ())):
                 try:
@@ -347,11 +380,18 @@ class Env:
         # into every later test — the same bug class the startup unwind in
         # __aenter__ guards). Run every stop; re-raise the FIRST failure.
         stop_error: Optional[BaseException] = None
-        for closer in (self.manager.stop, self.eviction.stop,
+        # batcher stops right after the manager (its final drain flushes
+        # the last batch while the store is still live); the hub stops
+        # after the tracker, whose completion subscribers call hub.wake
+        for closer in (self.manager.stop,
+                       *((self.status_batcher.stop,)
+                         if self.status_batcher is not None else ()),
+                       self.eviction.stop,
                        *((self.opts.node_faults.stop,)
                          if self.opts.node_faults is not None else ()),
                        *((self.tracker.stop,)
                          if self.tracker is not None else ()),
+                       self.wakehub.stop,
                        *((self.informers.stop,)
                          if self.informers is not None else ()),
                        *((self.stall.stop,)
@@ -399,6 +439,9 @@ class Env:
         if self.opts.node_faults is not None:
             named.append(("node-fault-injector",
                           getattr(self.opts.node_faults, "_task", None)))
+        if self.status_batcher is not None:
+            named.append(("status-batcher", self.status_batcher._task))
+        named += [("wakehub wake", t) for t in self.wakehub._tasks]
         return named
 
     def informer_cache_sizes(self) -> dict[str, int]:

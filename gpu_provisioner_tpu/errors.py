@@ -37,6 +37,12 @@ REASON_CREATED = "Created"
 # tier): the claim can never launch as specified — terminal, like
 # InsufficientCapacityError, but carrying the walk's verdict as a reason.
 REASON_STOCKOUT = "Stockout"
+# Every remaining candidate is memo-suppressed (a live stockout-TTL verdict,
+# no fresh probe spent) AND the provider is configured to park rather than
+# terminate (``ProviderConfig.stockout_park``): retryable — the WakeHub
+# re-wakes the claim when the earliest memo expires, and the requeue ladder
+# is the safety net. Default-off config keeps the pinned terminal semantics.
+REASON_STOCKOUT_SUPPRESSED = "StockoutSuppressed"
 
 # Reasons that mean "this claim can never converge as specified": the
 # lifecycle launch reconciler deletes the NodeClaim (KAITO retries with a
@@ -64,6 +70,7 @@ KNOWN_REASONS = frozenset({
     REASON_QUEUED_PROVISIONING, REASON_DEGRADED_POOL, REASON_NODES_NOT_READY,
     REASON_SUPERSEDED, REASON_DISCARDED, REASON_DELETE_TIMEOUT,
     REASON_DELETED, REASON_CREATED, REASON_STOCKOUT,
+    REASON_STOCKOUT_SUPPRESSED,
 })
 
 

@@ -24,6 +24,7 @@ LRO's resolution to the claim's ``registered`` annotation.
 
 from __future__ import annotations
 
+import bisect
 from typing import Iterable, Optional
 
 from .tracing import Trace
@@ -46,13 +47,21 @@ _PRIORITY = {
 }
 
 IDLE = "requeue-idle-gap"
+# Idle split by what ENDED the gap (the wake-source attr the workqueue
+# stamps on the queue-wait span that follows): "woken" = an event source
+# (watch/node/lro/stockout/status-flush) ended it early, "timer" = the
+# requeue_after safety net had to fire — residual polling, the thing the
+# wake graph exists to eliminate. Gaps nothing ended (the tail before
+# ready when ready precedes the next dequeue) stay plain IDLE.
+IDLE_WOKEN = "idle-gap:woken"
+IDLE_TIMER = "idle-gap:timer"
 UNATTRIBUTED = "reconcile-exec"
 
-# Phases that count toward the attribution gate. IDLE is named — "the claim
-# sat in requeue backoff" is an answer, and the one the coalesced-status
-# work needs. UNATTRIBUTED is deliberately not.
+# Phases that count toward the attribution gate. The idle flavors are
+# named — "the claim sat parked until X woke it" is an answer, and the
+# one the wake-graph work gates on. UNATTRIBUTED is deliberately not.
 NAMED_PHASES = ("queue-wait", "lro", "node-wait", "placement", "qr-wait",
-                "cloud-call", "status-write", IDLE)
+                "cloud-call", "status-write", IDLE, IDLE_WOKEN, IDLE_TIMER)
 
 
 def classify(span_name: str) -> Optional[str]:
@@ -103,6 +112,15 @@ def analyze_trace(trace: Trace, t0: Optional[float] = None,
     ivals = [(max(s, t0), min(e, ready), p)
              for s, e, p in _intervals(trace) if e > t0 and s < ready]
     points = sorted({t0, ready, *(p for iv in ivals for p in iv[:2])})
+    # Wake points: span starts carrying a ``wake`` attr (the queue-wait
+    # span for a normal dequeue; the reconcile span when queue-wait was
+    # zero). An idle segment whose END coincides with a wake point was
+    # terminated by that wake — classify it by the wake's kind.
+    wakes = sorted((max(s.start, t0),
+                    "timer" if s.attrs.get("wake") == "timer" else "woken")
+                   for s in trace.spans
+                   if s.attrs.get("wake") and t0 < s.start <= ready + 1e-9)
+    wake_times = [w[0] for w in wakes]
     phases: dict[str, float] = {}
     for lo, hi in zip(points, points[1:]):
         mid = (lo + hi) / 2
@@ -112,6 +130,10 @@ def analyze_trace(trace: Trace, t0: Optional[float] = None,
                 best, best_pri = p, _PRIORITY[p]
         if best == "reconcile":
             best = UNATTRIBUTED
+        elif best == IDLE:
+            i = bisect.bisect_left(wake_times, hi - 1e-9)
+            if i < len(wake_times) and wake_times[i] <= hi + 1e-9:
+                best = IDLE_TIMER if wakes[i][1] == "timer" else IDLE_WOKEN
         phases[best] = phases.get(best, 0.0) + (hi - lo)
 
     wall = ready - t0

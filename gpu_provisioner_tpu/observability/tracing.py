@@ -246,16 +246,29 @@ class Tracer:
 
     @contextlib.contextmanager
     def reconcile_span(self, controller: str, claim: str,
-                       queue_wait: Optional[float] = None
+                       queue_wait: Optional[float] = None,
+                       wake_source: Optional[str] = None
                        ) -> Iterator[Optional[_OpenSpan]]:
         """The controller trace seam body: record the queue-wait that ended
-        at this dequeue as a completed span, then cover the reconcile."""
+        at this dequeue as a completed span, then cover the reconcile.
+        ``wake_source`` (what put the item into the ready queue — watch,
+        node, lro, timer, stockout, status-flush) is stamped as a ``wake``
+        attr on the queue-wait span; the critical-path analyzer uses the
+        queue-wait's *start* as the moment the preceding idle gap ended, so
+        the attr lets it split requeue-idle-gap into woken-early vs
+        timer-fired."""
         if self.enabled and queue_wait is not None and queue_wait > 0:
             end = _mono()
+            wattrs = {"wake": wake_source} if wake_source else {}
             self.record_span(claim, "queue-wait", end - queue_wait, end,
-                             controller=controller)
+                             controller=controller, **wattrs)
         token = self.span_begin(claim, f"reconcile:{controller}",
                                 controller=controller)
+        if (token is not None and wake_source
+                and not (queue_wait is not None and queue_wait > 0)):
+            # Zero queue-wait dequeues still carry their wake cause — stamp
+            # it on the reconcile span so attribution sees every wake.
+            token.span.attrs["wake"] = wake_source
         try:
             yield token
         finally:

@@ -178,11 +178,19 @@ async def run_real(opts) -> int:
         tracer = Tracer(trace_store)
         trace_ids = current_ids
 
+    from ..runtime.wakehub import WakeHub
+
+    # Event-driven wake graph: every requeue-producing path (tracker LRO
+    # completions, Node watch events, stockout parking, status-flush) wakes
+    # the lifecycle queue through this hub; requeue_after becomes the
+    # safety-net deadline rather than the primary wake-up.
+    wakehub = WakeHub()
     provider = InstanceProvider(
         nodepools, kube,
         ProviderConfig(project=cfg.project_id, zone=cfg.location,
                        cluster=cfg.cluster_name),
         queued=queued, tracer=tracer)
+    provider.wakehub = wakehub
     from ..providers.operations import OperationTracker
 
     # Non-blocking provisioning: one background poller multiplexes every
@@ -201,6 +209,16 @@ async def run_real(opts) -> int:
         launch_timeout=opts.launch_timeout_seconds,
         registration_timeout=opts.registration_timeout_seconds,
         termination_requeue=opts.termination_requeue_seconds)
+    from ..controllers.statusbatch import StatusWriteBatcher
+
+    # Status-write coalescing: per-claim meta+status flushes batch over the
+    # flush window (latest-wins); fence assigned post-election like the
+    # provider's. window <= 0 keeps the legacy synchronous flush.
+    status_batcher = None
+    if lifecycle.status_flush_window > 0:
+        status_batcher = StatusWriteBatcher(
+            kube, window=lifecycle.status_flush_window,
+            tracer=tracer, wakehub=wakehub)
     controllers, eviction = build_controllers(
         kube, cloudprovider, Recorder(kube, trace_ids=trace_ids),
         lifecycle_options=lifecycle,
@@ -223,7 +241,8 @@ async def run_real(opts) -> int:
         node_repair=opts.feature_gates.node_repair,
         cluster=cfg.cluster_name,
         shards=opts.shards, shard_index=opts.shard_index,
-        tracker=tracker, tracer=tracer)
+        tracker=tracker, tracer=tracer,
+        wakehub=wakehub, status_batcher=status_batcher)
     manager = Manager(kube).register(*controllers)
 
     stop = asyncio.Event()
@@ -248,11 +267,15 @@ async def run_real(opts) -> int:
         # leader acts. Nothing has started yet, so assignment here is safe.
         fence = elector.fence()
         provider.fence = fence
+        if status_batcher is not None:
+            status_batcher.fence = fence
         for c in controllers:
             c.fence = fence
 
     await kube.start()  # informers sync before the first reconcile
     tracker.start()
+    if status_batcher is not None:
+        status_batcher.start()
     eviction.start()
     await manager.start()
     runners = await start_servers(manager, opts.metrics_port,
@@ -272,8 +295,13 @@ async def run_real(opts) -> int:
         await stop.wait()
     finally:
         await manager.stop()
+        # final drain flushes the last batch before the store goes away;
+        # the hub stops after the tracker, whose subscribers call its wake
+        if status_batcher is not None:
+            await status_batcher.stop()
         await eviction.stop()
         await tracker.stop()
+        await wakehub.stop()
         await kube.stop()
         if elector is not None:
             await elector.stop()

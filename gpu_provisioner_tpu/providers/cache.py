@@ -219,6 +219,15 @@ class TTLMemo:
         if self._stamps.pop(key, None) is not None:
             self._count("invalidations")
 
+    def remaining(self, key: str) -> float:
+        """Seconds of suppression left for ``key`` (0.0 when no live memo).
+        A pure read — no stats counting, no expiry side effects — so wake
+        scheduling can peek without skewing memo-effectiveness metrics."""
+        stamp = self._stamps.get(key)
+        if stamp is None or self.ttl <= 0:
+            return 0.0
+        return max(0.0, self.ttl - (self._now() - stamp))
+
     def __len__(self) -> int:
         return len(self._stamps)
 

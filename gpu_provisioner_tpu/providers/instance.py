@@ -45,9 +45,10 @@ from ..errors import (
     REASON_CREATE_IN_PROGRESS, REASON_DEGRADED_POOL, REASON_INVALID_NAME,
     REASON_INVALID_STORAGE_REQUEST, REASON_LAUNCH_FAILED,
     REASON_NODES_NOT_READY, REASON_QUEUED_PROVISIONING, REASON_STOCKOUT,
-    REASON_UNRESOLVABLE_SHAPE,
+    REASON_STOCKOUT_SUPPRESSED, REASON_UNRESOLVABLE_SHAPE,
 )
 from ..runtime.client import Client, patch_retry
+from ..runtime.wakehub import SOURCE_STOCKOUT
 from ..scheduling import Requirements
 from .cache import CountingAPI, ReadThroughCache
 from .operations import BackoffLadder, OP_DELETE, OperationTracker
@@ -179,6 +180,14 @@ class ProviderConfig:
     stockout_memo_ttl: float = 5.0
     spot_demote_threshold: int = 3
     spot_demote_window: float = 60.0
+    # Stockout parking (default OFF — the pinned contract is that a claim
+    # whose every candidate is exhausted/memo-suppressed terminates so the
+    # workload controller can re-shape it). When on, a walk that was
+    # suppressed WITHOUT spending a fresh probe — every skip was a live
+    # stockout memo, not this claim's own attempt history — raises the
+    # retryable StockoutSuppressed reason instead, and the provider's
+    # WakeHub re-wakes the claim when the earliest memo expires.
+    stockout_park: bool = False
     # Pre-fast-path list() (one kube Node list PER POOL, serially) — kept
     # only as the benchmark baseline (bench/bench_provision.py measures the
     # fast path against it). Never enable in production.
@@ -225,6 +234,9 @@ class InstanceProvider:
         # optional: spans cover the create/delete state-machine steps so the
         # critical-path analyzer can attribute a claim's ready-wall.
         self.tracer = tracer
+        # WakeHub (runtime/wakehub.py), assigned by the boot path / envtest
+        # like the fence: stockout parking arms memo-expiry wakes on it.
+        self.wakehub = None
         # Placement engine (providers/placement.py): preference-ordered
         # zone × shape × tier candidates, per-zone stockout memo, spot
         # demotion hysteresis. The default single-zone/no-tier config yields
@@ -407,10 +419,22 @@ class InstanceProvider:
         chosen: Optional[Candidate] = None
         op = None
         adopted = False
+        # Stockout parking: the shortest memo TTL among candidates skipped
+        # ONLY by a live memo (not this claim's own attempt history) — those
+        # become probeable again when the memo expires, so exhaustion is a
+        # wait, not a verdict.
+        park_wait: Optional[float] = None
         with self._span(name, "placement", candidates=len(candidates)):
             for cand in candidates:
-                if cand.key in attempted or self.placement.suppressed(cand):
+                if cand.key in attempted:
                     dry.append(cand.key)
+                    continue
+                if self.placement.suppressed(cand):
+                    dry.append(cand.key)
+                    if self.cfg.stockout_park:
+                        rem = self.placement.suppressed_remaining(cand)
+                        if rem > 0 and (park_wait is None or rem < park_wait):
+                            park_wait = rem
                     continue
                 pool = self._new_nodepool_object(
                     nc, cand.shape, capacity_type,
@@ -453,6 +477,19 @@ class InstanceProvider:
                 chosen = cand
                 break
         if chosen is None:
+            if park_wait is not None:
+                # Every non-attempted candidate is only TEMPORARILY dry (a
+                # live memo, no probe spent): park the claim — retryable
+                # error onto the backoff ladder as the safety net, with the
+                # hub wake at memo expiry as the primary wake-up.
+                if self.wakehub is not None:
+                    self.wakehub.wake_after(name, park_wait + 0.01,
+                                            SOURCE_STOCKOUT)
+                raise CreateError(
+                    f"nodepool {name}: all candidates memo-suppressed; "
+                    f"parked ~{park_wait:.1f}s until the earliest stockout "
+                    f"memo expires",
+                    reason=REASON_STOCKOUT_SUPPRESSED) from last_err
             if len(candidates) == 1:
                 # legacy single-candidate contract: stockout maps to
                 # InsufficientCapacityError (launch deletes the claim and

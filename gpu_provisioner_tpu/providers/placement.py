@@ -170,6 +170,12 @@ class PlacementEngine:
         stockout without spending a cloud probe."""
         return self.memo.active(cand.memo_key)
 
+    def suppressed_remaining(self, cand: Candidate) -> float:
+        """Seconds until the candidate's stockout memo expires (0.0 when not
+        suppressed) — the stockout-park path arms its WakeHub timer with the
+        minimum of these across the skipped candidates."""
+        return self.memo.remaining(cand.memo_key)
+
     def note_stockout(self, cand: Candidate) -> None:
         self.memo.mark(cand.memo_key)
         STOCKOUTS[cand.zone] += 1

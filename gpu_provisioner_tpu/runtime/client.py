@@ -86,6 +86,23 @@ class Watch:
             raise StopAsyncIteration
         return ev
 
+    def try_next(self) -> Optional[WatchEvent]:
+        """Non-blocking pop: the next buffered event, or None when the
+        stream is drained (or closed). Lets a single-task consumer (the
+        informer pump) drain a burst in one scheduling slot instead of
+        paying a wait_for task + timer round-trip per event — under a
+        provisioning wave that per-event overhead made the pump the
+        slowest stage of the whole watch path."""
+        if self._closed:
+            return None
+        try:
+            ev = self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if ev is _CLOSED:
+            return None
+        return ev
+
     def close(self) -> None:
         if self._closed:
             return

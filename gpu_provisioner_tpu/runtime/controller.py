@@ -21,6 +21,7 @@ from typing import Awaitable, Callable, Optional, Protocol
 from ..apis.meta import Object
 from .client import Client
 from .store import WatchEvent
+from .wakehub import SOURCE_INJECT, SOURCE_WATCH
 from .workqueue import RateLimitingQueue
 
 log = logging.getLogger("runtime.controller")
@@ -61,6 +62,10 @@ class _Source:
     cls: type
     map_fn: MapFn
     predicate: Optional[Predicate]
+    # Wake-source label stamped on enqueues from this watch (e.g. "node"
+    # for a Node watch mapped onto claim requests) — feeds the claimtrace
+    # idle-gap:woken / idle-gap:timer split and the wakes counter.
+    wake_source: Optional[str] = None
 
 
 SINGLETON_REQUEST = Request(name="singleton")
@@ -104,6 +109,9 @@ class Controller:
         # assigned by the registry (build_controllers) / operator boot path
         # once leadership is won — construction predates the election
         self.fence = None
+        # assigned by the registry: which shard this controller instance
+        # belongs to (labels the per-shard queue-depth gauge)
+        self.shard_index = 0
         self.queue = RateLimitingQueue()
         self.sources: list[_Source] = []
         self.singleton = False
@@ -113,11 +121,14 @@ class Controller:
         self._metrics_hook: Optional[Callable[[str, float, Optional[str]], None]] = None
         self._exhausted_hook: Optional[Callable[[str, Request, int], Awaitable[None]]] = None
         self._trace_seam: Optional[
-            Callable[[str, Request, Optional[float]], object]] = None
+            Callable[[str, Request, Optional[float], Optional[str]],
+                     object]] = None
 
     def watches(self, cls: type, map_fn: Optional[MapFn] = None,
-                predicate: Optional[Predicate] = None) -> "Controller":
-        self.sources.append(_Source(cls, map_fn or _default_map, predicate))
+                predicate: Optional[Predicate] = None,
+                wake_source: Optional[str] = None) -> "Controller":
+        self.sources.append(_Source(cls, map_fn or _default_map, predicate,
+                                    wake_source))
         return self
 
     def as_singleton(self) -> "Controller":
@@ -134,25 +145,31 @@ class Controller:
         self._exhausted_hook = hook
 
     def set_trace_seam(self, seam) -> None:
-        """``seam(controller_name, req, queue_wait_seconds) -> context
-        manager`` entered around each reconcile (same upward-pointing
-        dependency rule as the metrics/exhausted hooks: tracing lives above
-        the runtime layer). Because it is entered inside the worker task,
-        contextvars it sets propagate into every await the reconciler
-        makes — providers and clients see the active span."""
+        """``seam(controller_name, req, queue_wait_seconds, wake_source) ->
+        context manager`` entered around each reconcile (same
+        upward-pointing dependency rule as the metrics/exhausted hooks:
+        tracing lives above the runtime layer). Because it is entered
+        inside the worker task, contextvars it sets propagate into every
+        await the reconciler makes — providers and clients see the active
+        span."""
         self._trace_seam = seam
 
-    async def inject(self, name: str, namespace: str = "") -> None:
+    async def inject(self, name: str, namespace: str = "",
+                     source: str = SOURCE_INJECT) -> None:
         """External wake-up seam: enqueue a reconcile for ``name`` NOW.
 
-        Used by completion sources outside the watch stream — the operation
-        tracker injects a pool's request the tick its LRO resolves, so a
-        claim parked on ``Result(requeue_after=...)`` is reconciled
-        immediately instead of a full requeue interval later. Dedup and
+        Used by completion sources outside the watch stream — the WakeHub
+        fans LRO completion, node readiness, stockout-TTL expiry and
+        status-flush events into this seam, so a claim parked on
+        ``Result(requeue_after=...)`` is reconciled the tick its awaited
+        state changes instead of a full requeue interval later. Dedup and
         processing-set semantics are the workqueue's own (an item mid-flight
         is marked dirty and re-queued after ``done``), so a wake can never
-        be lost or duplicated into concurrent reconciles."""
-        await self.queue.add(Request(name=name, namespace=namespace))
+        be lost or duplicated into concurrent reconciles. ``source`` labels
+        the wake for the requeue_wakes counter and idle-gap attribution —
+        it matches the WakeHub sink signature ``sink(name, source=...)``."""
+        await self.queue.add(Request(name=name, namespace=namespace),
+                             source=source)
 
     # -- run --------------------------------------------------------------
     async def _pump(self, client: Client, src: _Source) -> None:
@@ -162,7 +179,8 @@ class Controller:
                 if src.predicate is not None and not src.predicate(ev.object):
                     continue
                 for req in src.map_fn(ev.object):
-                    await self.queue.add(req)
+                    await self.queue.add(req, source=src.wake_source
+                                         or SOURCE_WATCH)
         finally:
             w.close()
 
@@ -197,9 +215,10 @@ class Controller:
     async def _worker(self) -> None:
         while True:
             req = await self.queue.get()
-            # Always consume the queue-wait stamp (keeps the queue's wait
-            # map bounded) even when no trace seam is installed.
+            # Always consume the queue-wait and wake-source stamps (keeps
+            # the queue's maps bounded) even when no trace seam is installed.
             queue_wait = self.queue.pop_wait(req)
+            wake_src = self.queue.pop_wake_source(req)
             if self.fence is not None and not self.fence.valid():
                 # Deposed leader: single-writer discipline beats progress.
                 # Forget as well as done: a deposed-then-re-elected
@@ -214,7 +233,8 @@ class Controller:
             # The seam's context manager stays open across the requeue
             # bookkeeping too, so warning logs on the error paths carry the
             # reconcile's trace/span ids.
-            trace_ctx = (self._trace_seam(self.name, req, queue_wait)
+            trace_ctx = (self._trace_seam(self.name, req, queue_wait,
+                                          wake_src)
                          if self._trace_seam is not None
                          else contextlib.nullcontext())
             with trace_ctx:

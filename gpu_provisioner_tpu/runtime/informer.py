@@ -32,11 +32,57 @@ from typing import Optional
 
 from ..apis.meta import Object
 from .client import Client
-from .store import DELETED
+from .store import ADDED, DELETED, WatchEvent
 
 log = logging.getLogger("informer")
 
 RESYNC_SECONDS = 300.0
+
+_RELAY_CLOSED = object()
+
+
+class RelayWatch:
+    """Watch handle fed by an :class:`Informer` AFTER each event is applied
+    to its cache — the controller-runtime ordering guarantee (event handlers
+    fire post-cache-update). Without it a controller pump subscribed to the
+    raw store races the informer: a Node-ready event can enqueue a claim
+    whose reconcile then LISTs a cache that doesn't hold the flip yet, sees
+    stale not-ready state, and parks on its safety-net timer with the wake
+    already consumed (the BENCH_pr11 idle-gap:timer tail — 0.5s parks on
+    state that was already true).
+
+    Subscription replays the current cache as synthesized ADDED events
+    (store-watch ``initial_list`` parity, so objects created before a late
+    subscriber still reconcile). Same contract as the store watch: event
+    objects are shared and READ-ONLY; ``close()`` is idempotent and wakes a
+    blocked consumer."""
+
+    def __init__(self, informer: "Informer"):
+        self._informer = informer
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        for obj in informer._cache.values():
+            self._q.put_nowait(WatchEvent(ADDED, obj.deepcopy()))
+        informer._relays.append(self)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._closed:
+            raise StopAsyncIteration
+        ev = await self._q.get()
+        if ev is _RELAY_CLOSED or self._closed:
+            raise StopAsyncIteration
+        return ev
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self in self._informer._relays:
+            self._informer._relays.remove(self)
+        self._q.put_nowait(_RELAY_CLOSED)
 
 
 class Informer:
@@ -66,7 +112,33 @@ class Informer:
         # quietly re-create the cost the index exists to remove
         self._index_fns: dict[str, object] = {}
         self._by_index: dict[tuple[str, str], set] = {}
+        # post-cache-update event subscribers (RelayWatch); fan-out happens
+        # in _run strictly after _upsert/_remove so a relayed event is
+        # always observable through items() by the time a consumer sees it
+        self._relays: list[RelayWatch] = []
         self._task: Optional[asyncio.Task] = None
+
+    def subscribe(self) -> RelayWatch:
+        """A watch stream ordered AFTER this cache's updates."""
+        return RelayWatch(self)
+
+    def _apply(self, ev) -> None:
+        """Apply one watch event to the cache, then fan it out to relay
+        subscribers (strictly in that order — the relay's contract). Events
+        lost while the stream is down are healed for the CACHE by the
+        re-list in _run, and for relay consumers by their controllers'
+        periodic resync timers — RestWatch additionally self-heals with
+        tombstone replay before a break ever surfaces here."""
+        if ev.type == DELETED:
+            self._remove(ev.object)
+        else:
+            # CLONE before retaining: watch events share ONE object
+            # instance across all watchers (store.py's serde optimization)
+            # — storing it as-is would let any future event consumer's
+            # mutation corrupt this cache for the object's lifetime
+            self._upsert(ev.object.deepcopy())
+        for r in list(self._relays):
+            r._q.put_nowait(ev)
 
     def add_index(self, name: str, key_fn) -> None:
         self._index_fns[name] = key_fn
@@ -165,15 +237,21 @@ class Informer:
                                                     remaining)
                     except (asyncio.TimeoutError, StopAsyncIteration):
                         break
-                    if ev.type == DELETED:
-                        self._remove(ev.object)
-                    else:
-                        # CLONE before retaining: watch events share ONE
-                        # object instance across all watchers (store.py's
-                        # serde optimization) — storing it as-is would let
-                        # any future event consumer's mutation corrupt
-                        # this cache for the object's lifetime
-                        self._upsert(ev.object.deepcopy())
+                    # Batch-drain: after the blocking pop, pull the rest of
+                    # the burst non-blocking. One wait_for (task + timer
+                    # handle) PER EVENT made this single pump the slowest
+                    # stage of the watch path during a wave — with the
+                    # controllers' pumps now riding the post-cache relay,
+                    # that latency was theirs too (first-reconcile delays
+                    # of ~0.5s at 100 claims). Yield every 256 events so a
+                    # mega-wave burst can't hold the loop.
+                    burst = 0
+                    while ev is not None:
+                        self._apply(ev)
+                        burst += 1
+                        if burst % 256 == 0:
+                            await asyncio.sleep(0)
+                        ev = watch.try_next()
                     self.last_sync = loop.time()
             except asyncio.CancelledError:
                 watch.close()
@@ -300,4 +378,10 @@ class CachedListClient:
         return await self.inner.evict(name, namespace, uid=uid)
 
     def watch(self, cls):
+        # Cached kinds watch through the informer's post-cache-update relay
+        # (controller-runtime parity: a handler never observes an event its
+        # cache can't serve yet); uncached kinds pass through as before.
+        inf = self._informers.get(cls)
+        if inf is not None:
+            return inf.subscribe()
         return self.inner.watch(cls)

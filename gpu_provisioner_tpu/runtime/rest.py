@@ -387,6 +387,19 @@ class RestWatch:
             raise StopAsyncIteration
         return ev
 
+    def try_next(self) -> Optional[WatchEvent]:
+        """Non-blocking pop, same contract as runtime.Watch.try_next: the
+        informer pump drains bursts in one scheduling slot."""
+        if self._closed:
+            return None
+        try:
+            ev = self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if ev is None:
+            return None
+        return ev
+
     def close(self) -> None:
         if self._closed:
             return

@@ -1,0 +1,128 @@
+"""WakeHub: the event-driven wake graph for requeued reconciles.
+
+PR 9's critical-path attribution showed requeue-idle-gap at 57% of wave
+wall: claims parked on ``Result(requeue_after=...)`` timers waiting for
+state that had already changed. The tracker-completion ``Controller.inject``
+seam proved the cure for ONE path (LRO completion); this module generalizes
+it into a first-class hub every requeue-producing path registers against —
+LRO completion, node registration/readiness watch events, placement
+stockout-TTL expiry, status-flush completion — so ``requeue_after`` becomes
+a safety-net deadline, never the primary wake-up.
+
+Layering: this is runtime code, so it never imports prometheus. Wake counts
+accumulate in the module-level ``WAKES`` registry (keyed by source) and are
+exported counter-by-delta at scrape time by ``controllers/metrics.py`` as
+``tpu_provisioner_requeue_wakes_total{source}`` — the STOCKOUTS_TOTAL idiom.
+The workqueue calls :func:`note_wake` at the enqueue that actually lands
+(dedup-dropped wakes are not counted), so hub-routed wakes, watch-borne
+wakes and safety-net timer firings all share one ledger.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+# source -> cumulative wakes that landed an enqueue. Module-level like
+# placement.STOCKOUTS: multiple hubs (multi-shard benches, test Envs in one
+# process) accumulate into one ledger; the exporter tracks deltas.
+WAKES: dict[str, int] = {}
+
+# Well-known wake sources (the label vocabulary; free-form strings work too).
+SOURCE_WATCH = "watch"            # primary object watch stream
+SOURCE_NODE = "node"              # node registration/readiness events
+SOURCE_LRO = "lro"                # tracked cloud operation completed
+SOURCE_TIMER = "timer"            # requeue_after safety net actually fired
+SOURCE_STOCKOUT = "stockout"      # placement stockout-TTL memo expired
+SOURCE_STATUS_FLUSH = "status-flush"  # batched status write landed
+SOURCE_INJECT = "inject"          # unattributed manual inject
+
+
+def note_wake(source: str) -> None:
+    WAKES[source] = WAKES.get(source, 0) + 1
+
+
+WakeSink = Callable[..., Awaitable[None]]
+
+
+class WakeHub:
+    """Fan-out point for out-of-band wake producers.
+
+    Sinks are async callables invoked as ``sink(name, source=source)`` —
+    ``Controller.inject`` matches directly. Producers that know a future
+    wake time (a stockout memo's TTL) use :meth:`wake_after`; the handle
+    bookkeeping keeps the envtest leak gate able to enumerate everything
+    the hub still owes the event loop.
+    """
+
+    def __init__(self) -> None:
+        self._sinks: list[WakeSink] = []
+        # Delivery tasks + delayed-wake handles are retained (provlint
+        # PL007 bug class) and reaped in stop().
+        self._tasks: set[asyncio.Task] = set()
+        self._handles: set[asyncio.TimerHandle] = set()
+        self._stopped = False
+        self.delivered_total = 0
+
+    def register(self, sink: WakeSink) -> None:
+        self._sinks.append(sink)
+
+    async def wake(self, name: str, source: str) -> None:
+        """Deliver a wake for ``name`` to every registered sink NOW.
+
+        Dedup is the workqueue's: a wake for an item already enqueued (or
+        dirty-while-processing) collapses there, so waking is always safe
+        and never duplicates reconciles.
+        """
+        if self._stopped:
+            return
+        self.delivered_total += 1
+        for sink in list(self._sinks):
+            await sink(name, source=source)
+
+    def wake_after(self, name: str, delay: float, source: str) -> None:
+        """Schedule a wake for ``name`` in ``delay`` seconds (loop clock).
+
+        Fire-and-forget from sync code (the placement walk); the timer
+        handle and the delivery task it spawns are both retained so stop()
+        — and the leak gate — can account for them.
+        """
+        if self._stopped:
+            return
+        if delay <= 0:
+            self._spawn(name, source)
+            return
+        loop = asyncio.get_event_loop()
+        handle: asyncio.TimerHandle = loop.call_later(
+            delay, self._fire, name, source)
+        self._handles.add(handle)
+        # call_later handles carry no completion callback; prune opportunistically
+        self._handles = {h for h in self._handles if not h.cancelled()
+                         and h.when() >= loop.time() - 1.0} | {handle}
+
+    def _fire(self, name: str, source: str) -> None:
+        self._spawn(name, source)
+
+    def _spawn(self, name: str, source: str) -> None:
+        if self._stopped:
+            return
+        task = asyncio.ensure_future(self.wake(name, source))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def pending(self) -> int:
+        """Delayed wakes + in-flight deliveries the hub still owns."""
+        live_handles = sum(1 for h in self._handles if not h.cancelled())
+        return live_handles + len(self._tasks)
+
+    async def stop(self) -> None:
+        """Cancel delayed wakes and reap in-flight deliveries."""
+        self._stopped = True
+        for h in self._handles:
+            h.cancel()
+        self._handles.clear()
+        tasks, self._tasks = set(self._tasks), set()
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)

@@ -20,6 +20,8 @@ import time
 from collections import deque
 from typing import Any, Hashable, Optional
 
+from .wakehub import SOURCE_TIMER, note_wake
+
 
 class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0,
@@ -42,8 +44,24 @@ class RateLimitingQueue:
         # is the requeue-idle-gap phase, not queue congestion.
         self._enqueued: dict[Hashable, float] = {}
         self._waits: dict[Hashable, float] = {}
+        # Wake-source stamps, parallel to the queue-wait stamps: what put
+        # the item into the ready queue (watch/node/lro/timer/...), set at
+        # the enqueue that landed (first cause wins — it ended the idle),
+        # popped by the worker via pop_wake_source() and threaded into the
+        # claimtrace queue-wait span so critical-path attribution can split
+        # requeue-idle-gap into "woken early" vs "timer fired".
+        self._wake_srcs: dict[Hashable, str] = {}
+        self._woken_by: dict[Hashable, str] = {}
         self._failures: dict[Hashable, int] = {}
-        self._delayed: list[tuple[float, int, Hashable]] = []
+        # Delayed entries carry the item's wake epoch at push time: any
+        # later enqueue (a watch event, a hub wake) bumps the epoch, so a
+        # safety-net requeue_after timer whose item was already woken —
+        # and reconciled, and possibly re-parked — is dropped as stale
+        # instead of firing a spurious extra reconcile. The reconcile that
+        # consumed the wake re-arms its own safety net if it still waits.
+        self._delayed: list[tuple[float, int, Hashable, int]] = []
+        self._epoch: dict[Hashable, int] = {}
+        self.stale_timer_drops = 0
         self._seq = 0
         self._cond = asyncio.Condition()
         self._shutdown = False
@@ -57,29 +75,37 @@ class RateLimitingQueue:
         self._timer_wake = asyncio.Event()
 
     # -- core add/get/done ------------------------------------------------
-    def _add_locked(self, item: Hashable) -> None:
+    def _add_locked(self, item: Hashable,
+                    source: Optional[str] = None) -> None:
         if self._shutdown or item in self._dirty:
             return
         self._dirty.add(item)
+        self._epoch[item] = self._epoch.get(item, 0) + 1
+        if source is not None:
+            self._wake_srcs[item] = source
+            note_wake(source)
         if item in self._processing:
             return  # will be re-queued on done()
         self._queue.append(item)
         self._enqueued[item] = time.monotonic()
         self._cond.notify()
 
-    async def add(self, item: Hashable) -> None:
+    async def add(self, item: Hashable,
+                  source: Optional[str] = None) -> None:
         async with self._cond:
-            self._add_locked(item)
+            self._add_locked(item, source=source)
 
     async def add_after(self, item: Hashable, delay: float) -> None:
         if delay <= 0:
-            await self.add(item)
+            await self.add(item, source=SOURCE_TIMER)
             return
         async with self._cond:
             if self._shutdown:
                 return
             self._seq += 1
-            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            heapq.heappush(self._delayed,
+                           (time.monotonic() + delay, self._seq, item,
+                            self._epoch.get(item, 0)))
             if self._timer is None or self._timer.done():
                 self._timer = asyncio.create_task(self._timer_loop())
             else:
@@ -127,6 +153,12 @@ class RateLimitingQueue:
         async with self._cond:
             self._failures.pop(item, None)
             self._last_delay.pop(item, None)
+            # _epoch is deliberately NOT popped here: a forget-then-re-arm
+            # would reset the counter to 0, letting an older parked entry
+            # (also pushed at 0, before an intervening wake) match again
+            # and fire spuriously — the exact double-fire the epoch guard
+            # exists to drop. The cost is one small int per distinct item
+            # ever enqueued — noise next to the store's own object cache.
 
     async def reset_failures(self, item: Hashable) -> None:
         """Clear the failure COUNTER but keep the jitter memory: the next
@@ -156,10 +188,16 @@ class RateLimitingQueue:
         nxt = None
         now = time.monotonic()
         while self._delayed:
-            due, _, item = self._delayed[0]
+            due, _, item, epoch = self._delayed[0]
             if due <= now:
                 heapq.heappop(self._delayed)
-                self._add_locked(item)
+                if epoch != self._epoch.get(item, 0):
+                    # superseded: the item was woken (and reconciled) after
+                    # this safety net was armed — firing it now would only
+                    # add a spurious reconcile
+                    self.stale_timer_drops += 1
+                    continue
+                self._add_locked(item, source=SOURCE_TIMER)
             else:
                 nxt = due - now
                 break
@@ -176,6 +214,9 @@ class RateLimitingQueue:
                     stamped = self._enqueued.pop(item, None)
                     if stamped is not None:
                         self._waits[item] = time.monotonic() - stamped
+                    src = self._wake_srcs.pop(item, None)
+                    if src is not None:
+                        self._woken_by[item] = src
                     return item
                 if self._shutdown:
                     raise asyncio.CancelledError("workqueue shut down")
@@ -186,6 +227,12 @@ class RateLimitingQueue:
         consumed exactly once (the worker pops it right after dequeue so
         the dict stays bounded by in-flight items)."""
         return self._waits.pop(item, None)
+
+    def pop_wake_source(self, item: Hashable) -> Optional[str]:
+        """What woke ``item`` for the ``get()`` that returned it (None when
+        the enqueue carried no source); consumed exactly once, same
+        bounded-by-in-flight contract as :meth:`pop_wait`."""
+        return self._woken_by.pop(item, None)
 
     async def done(self, item: Hashable) -> None:
         async with self._cond:

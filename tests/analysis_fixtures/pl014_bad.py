@@ -1,0 +1,17 @@
+"""PL014 true positives: requeue_after waits with no declared wake source."""
+
+from gpu_provisioner_tpu.runtime.controller import Result
+
+
+class Reconciler:
+    async def reconcile(self, req):
+        if self.launching(req):
+            # an in-progress wait parked on a bare timer: nothing says what
+            # event is supposed to arrive before the deadline fires
+            return Result(requeue_after=5.0)
+        return Result()
+
+    async def drain(self, node):
+        if not node.drained:
+            return Result(requeue_after=self.opts.requeue)
+        return Result()

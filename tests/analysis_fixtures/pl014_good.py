@@ -1,0 +1,24 @@
+"""PL014 true negatives: annotated waits, in-function WakeHub arming, and
+the requeue_after=None / plain-Result shapes that are not waits at all."""
+
+from gpu_provisioner_tpu.runtime.controller import Result
+
+
+class Reconciler:
+    async def reconcile(self, req):
+        if self.launching(req):
+            # wakes: lro — tracker completion via the WakeHub
+            return Result(requeue_after=5.0)
+        return Result()
+
+    async def parked(self, req, remaining):
+        # the function itself arms the hub: the timer is the safety net
+        self.wakehub.wake_after(req.name, remaining, "stockout")
+        return Result(requeue_after=remaining * 2)
+
+    async def aggregate(self, requeues):
+        # wakes: aggregate — min of the sub-reconcilers' annotated waits
+        return Result(requeue_after=min(requeues) if requeues else None)
+
+    async def done(self, req):
+        return Result(requeue_after=None)

@@ -43,6 +43,7 @@ CASES = [
     ("PL011", "pl011", {ROLE_TESTS}, 1),
     ("PL012", "pl012", {ROLE_PACKAGE}, 2),
     ("PL013", "pl013", {ROLE_PACKAGE}, 3),
+    ("PL014", "pl014", {ROLE_CONTROLLERS}, 2),
 ]
 
 
@@ -163,8 +164,8 @@ def test_comment_waiver_does_not_bleed_past_its_target_line(tmp_path):
     assert [(x.rule, x.line) for x in findings] == [("PL004", 4)]
 
 
-def test_catalog_has_at_least_ten_rules():
-    assert len(RULES) >= 10
+def test_catalog_has_at_least_fourteen_rules():
+    assert len(RULES) >= 14
     assert len({r.id for r in RULES}) == len(RULES)
     assert len({r.name for r in RULES}) == len(RULES)
 

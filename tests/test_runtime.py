@@ -343,6 +343,90 @@ async def test_informer_serves_lists_and_tracks_watch():
 
 
 @async_test
+async def test_informer_relay_orders_cache_before_handler():
+    """controller-runtime parity: a watch handed out by CachedListClient
+    delivers each event only AFTER the informer cache reflects it, and a
+    late subscription replays the current cache as synthesized ADDED
+    events. Pumps riding the raw store instead saw the PR 11 stale-read
+    race: a Node-ready event enqueued a reconcile whose slice_nodes LIST
+    hit the not-yet-updated informer cache and parked on a timer whose
+    wake was already consumed."""
+    from gpu_provisioner_tpu.apis.core import Node, NodeSpec, Pod
+    from gpu_provisioner_tpu.apis.meta import ObjectMeta
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+    from gpu_provisioner_tpu.runtime.informer import CachedListClient
+    from gpu_provisioner_tpu.runtime.store import ADDED
+
+    inner = InMemoryClient()
+    for n in _informer_test_objs():
+        await inner.create(n)
+    client = CachedListClient(inner, (Node,))
+    await client.start()
+    try:
+        w = client.watch(Node)
+        # late subscription: current cache replayed as ADDED, store-watch
+        # initial_list parity
+        replay = sorted([(await w.__anext__()).object.metadata.name
+                         for _ in range(3)])
+        assert replay == ["n0", "n1", "n2"]
+
+        await inner.create(Node(metadata=ObjectMeta(name="n9"),
+                                spec=NodeSpec()))
+        # the informer's own startup watch may re-apply the initial objects
+        # (idempotent upserts); consumers are level-triggered, so skip any
+        # such duplicates until the live event arrives
+        for _ in range(8):
+            ev = await asyncio.wait_for(w.__anext__(), 2.0)
+            if ev.object.metadata.name == "n9":
+                break
+        assert ev.type == ADDED and ev.object.metadata.name == "n9"
+        # the ordering guarantee: at delivery the cached LIST already
+        # serves the event's object — no sleep, checked synchronously
+        assert any(n.metadata.name == "n9" for n in await client.list(Node))
+
+        # close is idempotent and ends iteration
+        w.close()
+        w.close()
+        try:
+            await asyncio.wait_for(w.__anext__(), 2.0)
+            assert False, "closed relay kept yielding"
+        except StopAsyncIteration:
+            pass
+
+        # uncached kinds fall through to the inner client's watch
+        pw = client.watch(Pod)
+        assert type(pw).__name__ != "RelayWatch"
+        pw.close()
+    finally:
+        await client.stop()
+
+
+@async_test
+async def test_watch_try_next_nonblocking_drain():
+    """Watch.try_next: buffered events come back without awaiting, an empty
+    queue returns None (never blocks), and a closed watch returns None —
+    the informer pump's burst-drain contract."""
+    from gpu_provisioner_tpu.apis.core import Node, NodeSpec
+    from gpu_provisioner_tpu.apis.meta import ObjectMeta
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+
+    inner = InMemoryClient()
+    w = inner.watch(Node)
+    assert w.try_next() is None  # empty, not blocked
+    for i in range(3):
+        await inner.create(Node(metadata=ObjectMeta(name=f"t{i}"),
+                                spec=NodeSpec()))
+    got = []
+    ev = w.try_next()
+    while ev is not None:
+        got.append(ev.object.metadata.name)
+        ev = w.try_next()
+    assert got == ["t0", "t1", "t2"]
+    w.close()
+    assert w.try_next() is None
+
+
+@async_test
 async def test_cached_list_client_index_follows_updates():
     """Field-index and label-index bookkeeping across updates: an updated
     providerID/label must be discoverable under its new value and gone from

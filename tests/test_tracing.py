@@ -23,7 +23,7 @@ from gpu_provisioner_tpu.observability import (
     install_log_record_factory, render_waterfall, wave_attribution,
 )
 from gpu_provisioner_tpu.observability.critical_path import (
-    IDLE, UNATTRIBUTED, classify,
+    IDLE, IDLE_TIMER, IDLE_WOKEN, UNATTRIBUTED, classify,
 )
 from gpu_provisioner_tpu.runtime import InMemoryClient
 from gpu_provisioner_tpu.runtime.events import (
@@ -187,6 +187,27 @@ def test_priority_overlap_unattributed_exec_and_idle_gap():
     assert r["phases"][IDLE] == pytest.approx(1.0)
     # idle is NAMED (counts toward the gate); reconcile-exec is not
     assert r["attributed_fraction"] == pytest.approx(1.2 / 2.0)
+
+
+def test_idle_gap_splits_on_wake_source():
+    """An idle segment ending at a span that carries a ``wake`` attr is
+    reclassified by its cause: woken early by an event vs the safety-net
+    timer actually firing. Residual idle (no wake ended it) stays plain."""
+    tr = Trace("c0")
+    tr.add_span(_span("reconcile:lifecycle", 0.0, 1.0))
+    # parked 1.0→2.0, then woken by a node event
+    tr.add_span(Span(span_id="w1", parent_id="", name="queue-wait",
+                     start=2.0, end=2.1, attrs={"wake": "node"}))
+    tr.add_span(_span("reconcile:lifecycle#2", 2.1, 3.0))
+    # parked 3.0→4.0, then the requeue_after timer fired
+    tr.add_span(Span(span_id="w2", parent_id="", name="queue-wait",
+                     start=4.0, end=4.05, attrs={"wake": "timer"}))
+    tr.add_span(_span("reconcile:lifecycle#3", 4.05, 4.5))
+    tr.add_event(TraceEvent(name="ready", at=5.0))  # trailing residual idle
+    r = analyze_trace(tr, t0=0.0)
+    assert r["phases"][IDLE_WOKEN] == pytest.approx(1.0)
+    assert r["phases"][IDLE_TIMER] == pytest.approx(1.0)
+    assert r["phases"][IDLE] == pytest.approx(0.5)
 
 
 def test_derived_node_wait_from_lro_end_to_registered():

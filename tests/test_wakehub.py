@@ -1,0 +1,336 @@
+"""Event-driven wake graph (PR 11): wake-source plumbing through the
+workqueue/controller, WakeHub fan-out + delayed wakes, the stale-safety-net
+epoch guard, and the StatusWriteBatcher's coalescing/fence/ordering/crash
+contracts."""
+
+import asyncio
+import copy
+
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.controllers.statusbatch import StatusWriteBatcher
+from gpu_provisioner_tpu.envtest import EnvtestOptions, RestartableEnv
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.runtime import (
+    Controller, InMemoryClient, Manager, RateLimitingQueue, Request, Result,
+)
+from gpu_provisioner_tpu.runtime.wakehub import (
+    SOURCE_LRO, SOURCE_NODE, SOURCE_STOCKOUT, SOURCE_TIMER, WAKES, WakeHub,
+)
+
+from .conftest import async_test
+
+
+async def eventually(predicate, timeout=5.0, interval=0.01):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        r = predicate()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition never became true")
+        await asyncio.sleep(interval)
+
+
+class CountingReconciler:
+    def __init__(self):
+        self.seen: list[Request] = []
+
+    async def reconcile(self, req: Request) -> Result:
+        self.seen.append(req)
+        return Result()
+
+
+class _Fence:
+    def __init__(self, valid=False):
+        self._valid = valid
+
+    def valid(self):
+        return self._valid
+
+
+# ------------------------------------------------------- wake-source plumbing
+
+@async_test
+async def test_wake_source_attribution_and_dedup_not_counted():
+    base = WAKES.get(SOURCE_LRO, 0)
+    q = RateLimitingQueue()
+    await q.add("a", source=SOURCE_LRO)
+    await q.add("a", source=SOURCE_LRO)  # dedup-dropped: no wake landed
+    assert WAKES.get(SOURCE_LRO, 0) - base == 1
+    item = await q.get()
+    assert q.pop_wake_source(item) == SOURCE_LRO
+    assert q.pop_wake_source(item) is None  # consumed exactly once
+    await q.done(item)
+    await q.shutdown()
+
+
+@async_test
+async def test_delayed_requeue_lands_with_timer_source():
+    q = RateLimitingQueue()
+    await q.add_after("a", 0.02)
+    item = await asyncio.wait_for(q.get(), 2)
+    assert q.pop_wake_source(item) == SOURCE_TIMER
+    await q.done(item)
+    await q.shutdown()
+
+
+@async_test
+async def test_inject_while_parked_dedupes_and_drops_stale_timer():
+    """A hub wake for a claim parked on its requeue_after safety net must
+    reconcile it ONCE, and the superseded timer must be dropped as stale
+    instead of firing a second spurious reconcile."""
+    c = InMemoryClient()
+    r = CountingReconciler()
+    ctrl = Controller("test", r).watches(NodeClaim)
+    req = Request(name="x")
+    await ctrl.queue.add_after(req, 0.1)  # the safety-net deadline
+    mgr = Manager(c).register(ctrl)
+    await mgr.start()
+    try:
+        await ctrl.inject("x", source=SOURCE_LRO)  # the event arrives early
+        await eventually(lambda: len(r.seen) == 1)
+        await asyncio.sleep(0.25)  # well past the timer's due time
+        assert len(r.seen) == 1, "stale safety-net timer re-fired the claim"
+        assert ctrl.queue.stale_timer_drops == 1
+    finally:
+        await mgr.stop()
+
+
+# ------------------------------------------------------------------- WakeHub
+
+@async_test
+async def test_hub_fans_out_and_delivers_delayed_wakes():
+    hub = WakeHub()
+    got = []
+
+    async def sink(name, source=None):
+        got.append((name, source))
+
+    hub.register(sink)
+    hub.register(sink)
+    await hub.wake("x", SOURCE_NODE)
+    assert got == [("x", SOURCE_NODE)] * 2
+    hub.wake_after("y", 0.02, SOURCE_STOCKOUT)
+    assert hub.pending() >= 1
+    await eventually(lambda: ("y", SOURCE_STOCKOUT) in got)
+    await hub.stop()
+
+
+@async_test
+async def test_wake_after_stop_is_noop():
+    """A wake armed before stop() — or delivered after it — must never
+    reach a sink: the Env that owned the hub is gone, and a late inject
+    into a torn-down controller queue is the leak-gate bug class."""
+    hub = WakeHub()
+    got = []
+
+    async def sink(name, source=None):
+        got.append(name)
+
+    hub.register(sink)
+    hub.wake_after("x", 0.02, SOURCE_STOCKOUT)
+    await hub.stop()
+    await asyncio.sleep(0.05)
+    assert got == [] and hub.pending() == 0
+    await hub.wake("x", SOURCE_NODE)
+    hub.wake_after("x", 0, SOURCE_NODE)
+    await asyncio.sleep(0.01)
+    assert got == []
+
+
+# --------------------------------------------------------- StatusWriteBatcher
+
+class _RecordingClient:
+    """Delegating client that records the ORDER of meta vs status writes."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.ops: list[tuple[str, str]] = []
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def update(self, obj):
+        self.ops.append(("meta", obj.metadata.name))
+        return await self._inner.update(obj)
+
+    async def update_status(self, obj):
+        self.ops.append(("status", obj.metadata.name))
+        return await self._inner.update_status(obj)
+
+
+@async_test
+async def test_batcher_latest_wins_single_write():
+    client = InMemoryClient()
+    rec = _RecordingClient(client)
+    stored = await client.create(make_nodeclaim("b0"))
+    b = StatusWriteBatcher(rec, window=0.01)
+    b.start()
+    try:
+        s1 = copy.deepcopy(stored)
+        s1.status.provider_id = "first"
+        s2 = copy.deepcopy(stored)
+        s2.status.provider_id = "second"
+        await b.submit(s1)
+        await b.submit(s2)
+        await eventually(lambda: b.writes == 1)
+        got = await client.get(NodeClaim, "b0")
+        assert got.status.provider_id == "second"
+        assert b.coalesced == 1
+        # ONE status write for the two submits; no meta write (unchanged)
+        assert rec.ops == [("status", "b0")]
+    finally:
+        await b.stop()
+
+
+@async_test
+async def test_batcher_meta_lands_before_status():
+    client = InMemoryClient()
+    rec = _RecordingClient(client)
+    stored = await client.create(make_nodeclaim("b1"))
+    b = StatusWriteBatcher(rec, window=0.01)
+    b.start()
+    try:
+        s = copy.deepcopy(stored)
+        s.metadata.labels["topology"] = "2x4"
+        s.status.provider_id = "p0"
+        await b.submit(s)
+        await eventually(lambda: b.writes == 1)
+        assert rec.ops == [("meta", "b1"), ("status", "b1")]
+        got = await client.get(NodeClaim, "b1")
+        assert got.metadata.labels["topology"] == "2x4"
+        assert got.status.provider_id == "p0"
+    finally:
+        await b.stop()
+
+
+@async_test
+async def test_batcher_fence_drop():
+    client = InMemoryClient()
+    stored = await client.create(make_nodeclaim("b2"))
+    b = StatusWriteBatcher(client, window=0.01, fence=_Fence(valid=False))
+    b.start()
+    try:
+        s = copy.deepcopy(stored)
+        s.status.provider_id = "deposed"
+        await b.submit(s)
+        await eventually(lambda: b.fence_dropped == 1)
+        got = await client.get(NodeClaim, "b2")
+        assert got.status.provider_id == ""  # the deposed write never landed
+        assert b.writes == 0
+    finally:
+        await b.stop()
+
+
+@async_test
+async def test_batcher_overlay_reads_batched_writes_without_aliasing():
+    client = InMemoryClient()
+    stored = await client.create(make_nodeclaim("b3"))
+    b = StatusWriteBatcher(client, window=60.0)  # window never elapses
+    s = copy.deepcopy(stored)
+    s.metadata.labels["k"] = "v"
+    s.status.provider_id = "pending"
+    await b.submit(s)
+    fresh = await client.get(NodeClaim, "b3")
+    out = b.overlay(fresh)
+    assert out.metadata.labels["k"] == "v"
+    assert out.status.provider_id == "pending"
+    # the overlaid status is a copy: reconcile mutations must not reach
+    # into the pending snapshot mid-flight
+    out.status.provider_id = "mutated"
+    assert b._pending["b3"].status.provider_id == "pending"
+    b.drop("b3")
+    assert b.pending() == 0
+    await b.stop()
+
+
+class _FlakyClient:
+    """Delegating client whose first ``fail`` status writes raise, like a
+    chaos-injected transient apiserver error."""
+
+    def __init__(self, inner, fail=2):
+        self._inner = inner
+        self._fail = fail
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def update_status(self, obj):
+        if self._fail > 0:
+            self._fail -= 1
+            raise RuntimeError("transient apiserver error")
+        return await self._inner.update_status(obj)
+
+
+@async_test
+async def test_batcher_survives_transient_write_errors():
+    """A transient write error must not kill the batcher task (a dead
+    batcher silently loses every later status write — the chaos soak saw
+    exactly that as claims never converging). The failed snapshot is
+    re-queued and lands in a later window."""
+    client = InMemoryClient()
+    flaky = _FlakyClient(client, fail=2)
+    stored = await client.create(make_nodeclaim("b5"))
+    b = StatusWriteBatcher(flaky, window=0.01)
+    b.start()
+    try:
+        s = copy.deepcopy(stored)
+        s.status.provider_id = "eventually"
+        await b.submit(s)
+        await eventually(lambda: b.writes == 1)
+        assert b.retried == 2
+        assert not b._task.done()
+        got = await client.get(NodeClaim, "b5")
+        assert got.status.provider_id == "eventually"
+    finally:
+        await b.stop()
+
+
+@async_test
+async def test_batcher_window_self_clocks_to_flush_cost():
+    """Group-commit pacing: the next window is the base window while
+    flushes are cheap, the last flush's duration once flushes are slow,
+    and never more than max_window."""
+    b = StatusWriteBatcher(InMemoryClient(), window=0.05, max_window=1.0)
+    assert b._next_window() == 0.05          # no flush yet: base window
+    b._last_flush_s = 0.002                  # cheap flush: base window
+    assert b._next_window() == 0.05
+    b._last_flush_s = 0.4                    # slow flush: stretch to it
+    assert b._next_window() == 0.4
+    b._last_flush_s = 30.0                   # pathological flush: capped
+    assert b._next_window() == 1.0
+    await b.stop()
+
+
+@async_test
+async def test_batcher_stop_drains_accepted_writes():
+    client = InMemoryClient()
+    stored = await client.create(make_nodeclaim("b4"))
+    b = StatusWriteBatcher(client, window=60.0)
+    b.start()
+    s = copy.deepcopy(stored)
+    s.status.provider_id = "drained"
+    await b.submit(s)
+    await b.stop()  # clean shutdown: the final drain loses nothing
+    got = await client.get(NodeClaim, "b4")
+    assert got.status.provider_id == "drained"
+
+
+@async_test
+async def test_crash_between_accept_and_flush_is_recovery_adoptable():
+    """A crash drops the in-memory pending batch on the floor. That must be
+    safe: status is derived state, so the next incarnation's recovery
+    adoption re-reconciles the claim from store + cloud truth and
+    re-materializes whatever the lost flush would have written."""
+    renv = RestartableEnv(EnvtestOptions())
+    await renv.start()
+    try:
+        await renv.client.create(make_nodeclaim("c0"))
+        await asyncio.sleep(0.08)  # mid-wave: flushes accepted, some pending
+        await renv.restart()       # crash (pending batch lost) + fresh boot
+        claim = await renv.wait_ready("c0", timeout=30)
+        assert claim.status.provider_id
+    finally:
+        await renv.crash()
