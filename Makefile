@@ -26,7 +26,7 @@ help: ## Show this help
 LINT_BASELINE_STRICT ?= 0
 
 .PHONY: lint
-lint: ## Static analysis: ruff + mypy (advisory baseline when installed) + provlint (docs/STATIC_ANALYSIS.md)
+lint: ## Static analysis: ruff + mypy (advisory baseline when installed) + provlint + provgraph (docs/STATIC_ANALYSIS.md)
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 	  $(PY) -m ruff check gpu_provisioner_tpu tests \
 	    || { echo "lint: ruff baseline found issues"; \
@@ -38,6 +38,7 @@ lint: ## Static analysis: ruff + mypy (advisory baseline when installed) + provl
 	         [ "$(LINT_BASELINE_STRICT)" = "1" ] && exit 1 || true; }; \
 	else echo "lint: mypy not installed; skipping baseline layer"; fi
 	$(PY) -m gpu_provisioner_tpu.analysis gpu_provisioner_tpu tests
+	$(PY) -m gpu_provisioner_tpu.analysis.provgraph
 
 .PHONY: verify
 verify: lint unit-test trace-smoke ## Default verify path: static analysis, the unit suites, then the claimtrace smoke
@@ -51,9 +52,14 @@ e2etests: ## e2e suite: real operator subprocess vs HTTP fakes (Makefile:177-187
 	$(PY) -m pytest tests/e2e -q
 
 CHAOS_SEED ?= 7
+FUZZ_SEEDS ?= 20
+
+.PHONY: fuzz
+fuzz: ## Deterministic interleaving sweep: schedfuzz scenarios under FUZZ_SEEDS perturbed schedules (docs/STATIC_ANALYSIS.md)
+	$(PY) -m gpu_provisioner_tpu.analysis.schedfuzz --seeds $(FUZZ_SEEDS)
 
 .PHONY: chaos
-chaos: ## Chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_MODES.md)
+chaos: fuzz ## Interleaving sweep, then the chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_MODES.md)
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py tests/test_recovery.py -q -m chaos
 
 .PHONY: recover
@@ -80,7 +86,7 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11 gates
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12 gates
 	$(PY) -m bench.bench_megawave --gate
 	$(PY) -m bench.bench_provision
 

@@ -27,8 +27,16 @@ Caveat recorded in the JSON: in-process shard Envs share one event loop, so
 shard scaling here measures partitioning overhead/fairness (watch fan-out,
 queue balance), NOT parallel speedup — see docs/PERFORMANCE.md.
 
+PR 12 adds ``timer_wake_share`` to every harness: the fraction of requeue
+wakes fired by the workqueue's safety-net timer rather than an event
+producer. The event-driven graph keeps it near zero; a producer that falls
+off the hub pushes its entire wake class onto the timer, so the share is
+gated (≤ 5%) on the reference wave and the gate-tier mega-wave, and the
+gate-tier run is recorded as ``BENCH_pr12.json`` via ``--write-pr12``.
+
 Usage: python -m bench.bench_megawave [--gate | --full] [--claims N]
                                       [--shards 8] [--write-pr11]
+                                      [--write-pr12]
 """
 
 from __future__ import annotations
@@ -42,11 +50,17 @@ import time
 from pathlib import Path
 
 BENCH_PR11_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr11.json"
+BENCH_PR12_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr12.json"
 
 # PR 11 acceptance gates (criteria, not recorded budgets).
 IDLE_FRACTION_MAX = 0.15          # all idle flavors / attributed wall
 ATTRIBUTION_MIN = 0.95
 STATUS_PATCHES_PER_CLAIM_MAX = 3.0
+# PR 12: share of requeue wakes fired by the safety-net timer instead of an
+# event producer. Healthy waves measure ~0.01% (2 of ~15k wakes at 1k
+# claims); a single unregistered producer sends its whole wake class to the
+# timer fallback, so even this generous ceiling is a loud tripwire.
+TIMER_WAKE_SHARE_MAX = 0.05
 
 
 def _idle_phases(phases: dict) -> float:
@@ -65,6 +79,11 @@ def _wake_delta(before: dict) -> dict:
     from gpu_provisioner_tpu.runtime import wakehub
     return {k: v - before.get(k, 0) for k, v in wakehub.WAKES.items()
             if v - before.get(k, 0) > 0}
+
+
+def _timer_wake_share(wakes: dict) -> float:
+    total = sum(wakes.values())
+    return round(wakes.get("timer", 0) / total, 4) if total else 0.0
 
 
 # ----------------------------------------------------------- reference wave
@@ -113,6 +132,7 @@ async def bench_reference(n_claims: int = 100) -> dict:
             "writes": batcher.writes, "flushes": batcher.flushes,
         } if batcher is not None else None
     idle = _idle_phases(attribution["phases"]) if attribution else None
+    wakes = _wake_delta(wakes_before)
     return {
         "claims": n_claims,
         "ready_p50_s": round(statistics.median(readies), 4),
@@ -122,10 +142,21 @@ async def bench_reference(n_claims: int = 100) -> dict:
         "idle_all_flavors_s": round(idle, 6) if idle is not None else None,
         "idle_fraction": (round(idle / attribution["wall"], 4)
                           if attribution else None),
-        "wakes_by_source": _wake_delta(wakes_before),
+        "wakes_by_source": wakes,
+        "timer_wake_share": _timer_wake_share(wakes),
         "stale_timer_drops": stale_drops,
         "status_batcher": batcher_stats,
     }
+
+
+def check_timer_share(res: dict, label: str) -> list[str]:
+    share = res.get("timer_wake_share")
+    if share is None or share <= TIMER_WAKE_SHARE_MAX:
+        return []
+    return [f"{label}: timer wakes are {100 * share:.1f}% of all requeue "
+            f"wakes > {100 * TIMER_WAKE_SHARE_MAX:.0f}% — an event producer "
+            "fell off the hub and its wake class is riding the safety-net "
+            f"timer (ledger: {res.get('wakes_by_source')})"]
 
 
 def check_reference(ref: dict) -> list[str]:
@@ -143,6 +174,7 @@ def check_reference(ref: dict) -> list[str]:
             f"{100 * ref['idle_fraction']:.1f}% of the critical claim's "
             f"wall > {100 * IDLE_FRACTION_MAX:.0f}% (BENCH_pr09 baseline "
             "was 57% — are wake producers still registered on the hub?)")
+    out += check_timer_share(ref, "reference")
     return out
 
 
@@ -279,6 +311,7 @@ async def bench_megawave(n_claims: int, shards: int,
 
     depths = [depth_peak[i] for i in range(shards)]
     idle = _idle_phases(attribution["phases"]) if attribution else None
+    wakes = _wake_delta(wakes_before)
     return {
         "claims": n_claims,
         "shards": shards,
@@ -289,7 +322,8 @@ async def bench_megawave(n_claims: int, shards: int,
         "peak_queue_depth_by_shard": depths,
         "peak_depth_imbalance": (round(max(depths) / max(min(depths), 1), 2)
                                  if shards > 1 else 1.0),
-        "wakes_by_source": _wake_delta(wakes_before),
+        "wakes_by_source": wakes,
+        "timer_wake_share": _timer_wake_share(wakes),
         "stale_timer_drops": stale_drops,
         "status_batcher": batch,
         "traced_sample": {
@@ -312,6 +346,7 @@ def check_megawave(res: dict) -> list[str]:
             f"status-patch volume regressed: "
             f"{res['status_patches_per_claim']:.2f}/claim > "
             f"{STATUS_PATCHES_PER_CLAIM_MAX} (batcher not coalescing?)")
+    out += check_timer_share(res, f"mega-wave@{res['shards']}sh")
     return out
 
 
@@ -348,9 +383,14 @@ async def run_gate(claims: int, shards: int) -> dict:
     gate_wave = await bench_megawave(claims, shards)
     return {
         "bench": "megawave-gate",
-        "pr": 11,
+        "pr": 12,
         "reference": reference,
         "gate_wave": gate_wave,
+        "gates": {"idle_fraction_max": IDLE_FRACTION_MAX,
+                  "attribution_min": ATTRIBUTION_MIN,
+                  "status_patches_per_claim_max":
+                      STATUS_PATCHES_PER_CLAIM_MAX,
+                  "timer_wake_share_max": TIMER_WAKE_SHARE_MAX},
     }
 
 
@@ -374,7 +414,8 @@ async def run_full(shard_counts: tuple[int, ...] = (1, 4, 8),
         "gates": {"idle_fraction_max": IDLE_FRACTION_MAX,
                   "attribution_min": ATTRIBUTION_MIN,
                   "status_patches_per_claim_max":
-                      STATUS_PATCHES_PER_CLAIM_MAX},
+                      STATUS_PATCHES_PER_CLAIM_MAX,
+                  "timer_wake_share_max": TIMER_WAKE_SHARE_MAX},
     }
 
 
@@ -393,6 +434,9 @@ def main(argv=None) -> int:
                     help="comma-separated shard counts for the full tier")
     ap.add_argument("--write-pr11", action="store_true",
                     help="rewrite BENCH_pr11.json with fresh numbers+budget")
+    ap.add_argument("--write-pr12", action="store_true",
+                    help="record the gate-tier run (wake-source ledger + "
+                         "timer_wake_share) as BENCH_pr12.json")
     args = ap.parse_args(argv)
 
     rc = 0
@@ -426,6 +470,9 @@ def main(argv=None) -> int:
         if BENCH_PR11_FILE.exists():
             recorded = json.loads(BENCH_PR11_FILE.read_text())
             violations += check_budget(results["gate_wave"], recorded)
+        if args.write_pr12:
+            BENCH_PR12_FILE.write_text(json.dumps(results, indent=2) + "\n")
+            print(f"wrote {BENCH_PR12_FILE}", file=sys.stderr)
 
     for v in violations:
         print(f"MEGAWAVE GATE: {v}", file=sys.stderr)

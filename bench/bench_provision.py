@@ -301,24 +301,60 @@ async def bench_traced_wave(n_claims: int, tracing: bool = True,
     }
 
 
-async def run_pr09(n_claims: int, repeats: int = 2) -> dict:
-    """Traced vs untraced wave. The overhead comparison uses the best of
-    ``repeats`` runs per mode — min-of-N damps scheduler noise, which at a
-    5% gate on a seconds-scale wall otherwise dominates the measurement."""
-    traced_runs = [await bench_traced_wave(n_claims, tracing=True)
-                   for _ in range(repeats)]
-    untraced_runs = [await bench_traced_wave(n_claims, tracing=False)
-                     for _ in range(repeats)]
-    traced = min(traced_runs, key=lambda r: r["ready_wall_s"])
-    untraced = min(untraced_runs, key=lambda r: r["ready_wall_s"])
-    overhead = (traced["ready_wall_s"]
-                / max(untraced["ready_wall_s"], 1e-9) - 1.0)
+# Claim count for the overhead pairs: sized so the wave stays LATENCY-bound
+# on a single busy core (~50-60% loop utilization). The attribution wave
+# runs at the full ``--claims`` size regardless.
+PR09_OVERHEAD_CLAIMS = 25
+
+
+async def run_pr09(n_claims: int, repeats: int = 3) -> dict:
+    """One full-size traced wave for the attribution gate, then the tracing
+    overhead measured on interleaved traced/untraced PAIRS of a smaller,
+    latency-bound wave, medians compared.
+
+    The previous shape — all traced runs then all untraced at the full
+    wave size, min-of-2 per group — flaked the 5% gate three ways. The
+    groups ran minutes apart, so machine-weather drift landed entirely on
+    one group and read as tracing overhead. Min-of-2 is an extreme
+    statistic, so one lucky untraced run shrank the denominator. Worst,
+    the full wave SATURATES a 1-core box (~95% loop utilization), where
+    the wall is step-quantized by poll/requeue boundaries — ~0.2s jumps on
+    a ~0.5s wave — so any extra CPU tips a quantum and reads as a 30-40%
+    "overhead" (the documented 37.9%-on-a-loaded-box failure). The pairs
+    therefore run a wave sized to keep the loop latency-bound, where wall
+    overhead actually measures tracing's cost rather than the box's
+    saturation threshold; pairing runs the modes back-to-back under the
+    same weather, and the median is robust to a single bad round. One
+    discarded warm-up pair absorbs allocator/import warm-up."""
+    traced = await bench_traced_wave(n_claims, tracing=True)
+
+    oh_claims = min(n_claims, PR09_OVERHEAD_CLAIMS)
+    await bench_traced_wave(oh_claims, tracing=True)
+    await bench_traced_wave(oh_claims, tracing=False)
+    traced_walls: list[float] = []
+    untraced_walls: list[float] = []
+    for _ in range(repeats):
+        t = await bench_traced_wave(oh_claims, tracing=True)
+        u = await bench_traced_wave(oh_claims, tracing=False)
+        traced_walls.append(t["ready_wall_s"])
+        untraced_walls.append(u["ready_wall_s"])
+
+    def median(walls: list[float]) -> float:
+        return sorted(walls)[len(walls) // 2]
+
+    overhead = median(traced_walls) / max(median(untraced_walls), 1e-9) - 1.0
     return {
         "bench": "claimtrace",
         "pr": 9,
         "traced": traced,
-        "untraced": {k: untraced[k] for k in
-                     ("ready_wall_s", "ready_p50_s", "ready_p95_s")},
+        "overhead": {
+            "claims": oh_claims,
+            "repeats": repeats,
+            "pairing": "interleaved",
+            "statistic": "median",
+            "traced_walls_s": traced_walls,
+            "untraced_walls_s": untraced_walls,
+        },
         "tracing_overhead_fraction": round(overhead, 4),
         "attribution": traced["attribution"],
         "gates": {"attributed_fraction_min": PR09_ATTRIBUTION_MIN,

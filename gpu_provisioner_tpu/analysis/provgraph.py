@@ -1,0 +1,665 @@
+"""provgraph: whole-program invariant analysis over the package graph.
+
+provlint (PL001–PL014) is one parsed module at a time — by design: each
+rule is a pure function over a single file, so the fixture corpus can drive
+any rule against any snippet. But every ordering/architecture bug PR 11
+root-caused crossed a module boundary, and the invariants that guard those
+bugs are *relations between files*: an import edge, a declared wake with a
+producer somewhere else, a fence check in the caller of a mutating helper,
+a metric name and its doc entry. provgraph is the second analysis
+generation for exactly those: it builds one :class:`ProgramGraph` over the
+package (import edges, a module-local call graph, wake annotations and
+producers, metric-name literals) and runs interprocedural rules against it.
+
+Rules (docs/STATIC_ANALYSIS.md#provgraph has the full catalog):
+
+- **PG001 layering-violation** — the paper's L1–L5 layer map (SURVEY §1)
+  as an enforced DAG: ``runtime/`` imports nothing above itself;
+  ``controllers/``, ``cloudprovider/`` and ``runtime/`` never import the
+  cloud-specific modules (``providers/gcp.py``, ``providers/rest.py`` —
+  the ROADMAP item-4 provider seam); ``providers/`` never imports
+  ``controllers/``; nothing imports ``operator/`` (the composition root).
+- **PG002 unproduced-wake-edge** — every ``# wakes: <source>`` annotation
+  (the PL014 contract at a ``requeue_after`` site) must have at least one
+  producer call site somewhere in the package that wakes with that source:
+  ``WakeHub.wake()/wake_after()``, ``Controller.inject``, a workqueue
+  enqueue ``source=...``, or a watch registered with ``wake_source=...``.
+  A declared-but-unproduced edge is the silent timer-only-path bug class
+  PR 11 killed.
+- **PG003 unfenced-mutation-path** — interprocedural PL003: a call into a
+  helper that (transitively) issues a cloud mutation without its own fence
+  check must itself be preceded by a fence check in the caller. PL003 only
+  sees the function containing the ``begin_create``; a helper that waives
+  PL003 with "caller holds the fence" is exactly what this rule audits.
+- **PG004 metrics-docs-drift** — every ``tpu_provisioner_*`` metric-name
+  literal in code appears in docs/OBSERVABILITY.md, and every
+  ``tpu_provisioner_*`` name the doc claims exists in code.
+
+Waivers use the provlint grammar with the ``provgraph`` tag::
+
+    from ..providers.gcp import parse_op  # provgraph: disable=PG001 — <why>
+
+The reason is mandatory; a malformed waiver is a **PG000** finding. The
+whole-tree run (``make lint`` / ``python -m
+gpu_provisioner_tpu.analysis.provgraph``) must be clean — zero unwaived
+findings — the same gate contract as provlint's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+from .provlint import (
+    FIXTURE_DIR, Finding, _comment_lines, _display, dotted_name,
+    parse_waivers,
+)
+from .rules import _is_cloud_mutation, _is_fence_call
+
+WAIVER_TAG = "provgraph"
+DEFAULT_DOC = "docs/OBSERVABILITY.md"
+
+# ------------------------------------------------------------------- graph
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                    # dotted: gpu_provisioner_tpu.runtime.informer
+    path: Path
+    display: str
+    source: str
+    lines: list[str]
+    tree: ast.Module
+    is_package: bool             # __init__.py
+
+
+@dataclasses.dataclass(frozen=True)
+class ImportEdge:
+    src: str                     # importing module (dotted)
+    dst: str                     # imported module (dotted, absolute)
+    line: int
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qual: str                    # "module:Class.method" / "module:func"
+    module: str
+    display: str
+    line: int
+    mutation_lines: list[int] = dataclasses.field(default_factory=list)
+    fence_lines: list[int] = dataclasses.field(default_factory=list)
+    # module-local calls this function makes: (callee qual, line) — only
+    # self.method() within the same class and bare module-function calls
+    # resolve (anything dynamic is out of scope, documented)
+    calls: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class WakeAnnotation:
+    module: str
+    display: str
+    line: int
+    source: str
+
+
+@dataclasses.dataclass
+class ProgramGraph:
+    """Everything the interprocedural rules consume, built in one pass."""
+
+    package: str
+    root: Path
+    modules: dict[str, ModuleInfo]
+    import_edges: list[ImportEdge]
+    functions: dict[str, FunctionInfo]
+    wake_annotations: list[WakeAnnotation]
+    wake_producers: set[str]            # resolved source values produced
+    metric_literals: list[tuple[str, str, int]]   # (name, display, line)
+    doc_path: Optional[Path]
+    doc_display: str
+    doc_metrics: dict[str, int]         # name -> first line in the doc
+
+    def segment(self, module: str) -> str:
+        """First path segment under the package root ('' for the root
+        module itself): 'runtime', 'controllers', 'transport', ..."""
+        parts = module.split(".")
+        return parts[1] if len(parts) > 1 else ""
+
+
+_WAKES_SRC_RE = re.compile(r"#\s*wakes:\s*([A-Za-z][\w-]*)")
+_METRIC_RE = re.compile(r"tpu_provisioner_[a-z0-9_]+")
+# doc-side mentions may carry alternation and label-selector braces:
+# `tpu_provisioner_workqueue_{depth,delayed}` / `..._wakes_total{source}`
+_DOC_METRIC_RE = re.compile(r"tpu_provisioner_[a-z0-9_{},]+")
+
+
+def _module_name(root: Path, f: Path) -> tuple[str, bool]:
+    rel = f.relative_to(root.parent)
+    parts = list(rel.parts)
+    is_pkg = parts[-1] == "__init__.py"
+    if is_pkg:
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts), is_pkg
+
+
+def _resolve_from(mod: ModuleInfo, node: ast.ImportFrom) -> Optional[str]:
+    """Absolute dotted target of a (possibly relative) ``from X import``."""
+    if node.level == 0:
+        return node.module
+    base = mod.name.split(".")
+    if not mod.is_package:
+        base = base[:-1]
+    drop = node.level - 1
+    if drop:
+        base = base[:-drop] if drop < len(base) else []
+    if not base:
+        return None  # relative import escaping the package — not our edge
+    return ".".join(base + (node.module.split(".") if node.module else []))
+
+
+def _expand_doc_token(token: str) -> list[str]:
+    """``a_{x,y}_b{label}`` → ``[a_x_b, a_y_b]``: comma-braces are
+    alternation (the doc's shorthand for metric families that differ in one
+    segment), comma-less braces are label selectors and are stripped."""
+    m = re.search(r"\{([^{}]*,[^{}]*)\}", token)
+    if m:
+        out: list[str] = []
+        for alt in m.group(1).split(","):
+            out.extend(_expand_doc_token(
+                token[:m.start()] + alt.strip() + token[m.end():]))
+        return out
+    return [re.sub(r"\{[^{}]*\}", "", token)]
+
+
+def _source_values(mod_imports: "_ImportTable", expr: ast.AST,
+                   consts: dict[str, str]) -> list[str]:
+    """Resolvable wake-source value(s) of an argument expression. String
+    literals and ``SOURCE_*`` constants resolve; ``a or b`` yields every
+    resolvable arm; variables/pass-throughs yield nothing (a producer is an
+    ORIGIN — ``sink(name, source=source)`` relays, it does not produce)."""
+    if isinstance(expr, ast.BoolOp):
+        out: list[str] = []
+        for v in expr.values:
+            out.extend(_source_values(mod_imports, v, consts))
+        return out
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    d = dotted_name(expr)
+    if d is not None:
+        last = mod_imports.resolve(d).split(".")[-1]
+        if last in consts:
+            return [consts[last]]
+    return []
+
+
+class _ImportTable:
+    """Per-module alias map for resolving ``SOURCE_*`` names (the provlint
+    Imports resolver, minus the ImportFrom-module ambiguity we don't
+    need)."""
+
+    def __init__(self, tree: ast.Module):
+        self.names: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.names[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = a.name
+
+    def resolve(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        head = self.names.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+
+def _collect_functions(mod: ModuleInfo) -> dict[str, FunctionInfo]:
+    """Top-level functions and one level of methods, with their direct
+    mutation/fence call lines and module-local call edges."""
+    out: dict[str, FunctionInfo] = {}
+
+    def scan(fn_node, qual: str) -> FunctionInfo:
+        info = FunctionInfo(qual=qual, module=mod.name, display=mod.display,
+                            line=fn_node.lineno)
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_cloud_mutation(node):
+                info.mutation_lines.append(node.lineno)
+            elif _is_fence_call(node):
+                info.fence_lines.append(node.lineno)
+            f = node.func
+            if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"):
+                info.calls.append((f"__self__.{f.attr}", node.lineno))
+            elif isinstance(f, ast.Name):
+                info.calls.append((f"{mod.name}:{f.id}", node.lineno))
+        return info
+
+    for top in mod.tree.body:
+        if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            q = f"{mod.name}:{top.name}"
+            out[q] = scan(top, q)
+        elif isinstance(top, ast.ClassDef):
+            for item in top.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{mod.name}:{top.name}.{item.name}"
+                    out[q] = scan(item, q)
+    # resolve the __self__ placeholders now that the class's methods exist
+    for qual, info in out.items():
+        cls = qual.split(":", 1)[1].rsplit(".", 1)
+        prefix = f"{mod.name}:{cls[0]}." if len(cls) == 2 else None
+        resolved: list[tuple[str, int]] = []
+        for callee, line in info.calls:
+            if callee.startswith("__self__."):
+                if prefix is None:
+                    continue
+                callee = prefix + callee[len("__self__."):]
+            if callee in out or not callee.startswith("__self__"):
+                resolved.append((callee, line))
+        info.calls = [(c, ln) for c, ln in resolved if c in out or ":" in c]
+    return out
+
+
+def build_graph(package_root: Path,
+                doc_path: Optional[Path] = None) -> ProgramGraph:
+    package_root = Path(package_root)
+    package = package_root.name
+    modules: dict[str, ModuleInfo] = {}
+    for f in sorted(package_root.rglob("*.py")):
+        # Relative to the ROOT, so a fixture package under
+        # tests/analysis_fixtures/ can itself be analyzed by the tests
+        # while nested fixture trees inside a real package stay excluded.
+        if FIXTURE_DIR in f.relative_to(package_root).parts:
+            continue
+        name, is_pkg = _module_name(package_root, f)
+        source = f.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(source, filename=str(f))
+        except SyntaxError:
+            continue  # provlint PL000 already reports unparseable files
+        modules[name] = ModuleInfo(
+            name=name, path=f, display=_display(f), source=source,
+            lines=source.splitlines(), tree=tree, is_package=is_pkg)
+
+    # ---- import edges (with from-import alias refinement) ----------------
+    edges: list[ImportEdge] = []
+    for mod in modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] == package:
+                        edges.append(ImportEdge(mod.name, a.name, node.lineno))
+            elif isinstance(node, ast.ImportFrom):
+                base = _resolve_from(mod, node)
+                if base is None or base.split(".")[0] != package:
+                    continue
+                edges.append(ImportEdge(mod.name, base, node.lineno))
+                for a in node.names:
+                    # `from ..providers import gcp` — the edge that matters
+                    # is providers.gcp, not providers
+                    refined = f"{base}.{a.name}"
+                    if refined in modules:
+                        edges.append(
+                            ImportEdge(mod.name, refined, node.lineno))
+
+    # ---- function table (module-local call graph) ------------------------
+    functions: dict[str, FunctionInfo] = {}
+    for mod in modules.values():
+        functions.update(_collect_functions(mod))
+
+    # ---- wake annotations + producers ------------------------------------
+    consts: dict[str, str] = {}
+    for mod in modules.values():
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("SOURCE_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                consts[node.targets[0].id] = node.value.value
+
+    annotations: list[WakeAnnotation] = []
+    producers: set[str] = set()
+    for mod in modules.values():
+        for i, text in enumerate(mod.lines, start=1):
+            m = _WAKES_SRC_RE.search(text)
+            if not m:
+                continue
+            # Comment-only annotations anchor at the code line they
+            # describe (same skip the waiver parser does), so a trailing
+            # or comment-only provgraph waiver lands where the finding is.
+            anchor = i
+            if text.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(mod.lines) and (
+                        not mod.lines[j - 1].strip()
+                        or mod.lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                if j <= len(mod.lines):
+                    anchor = j
+            annotations.append(WakeAnnotation(
+                mod.name, mod.display, anchor, m.group(1)))
+        table = _ImportTable(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            exprs: list[ast.AST] = [
+                kw.value for kw in node.keywords
+                if kw.arg in ("source", "wake_source")]
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "wake" and len(node.args) >= 2:
+                    exprs.append(node.args[1])
+                elif node.func.attr == "wake_after" and len(node.args) >= 3:
+                    exprs.append(node.args[2])
+            for e in exprs:
+                producers.update(_source_values(table, e, consts))
+
+    # ---- metric literals + doc catalog -----------------------------------
+    metric_literals: list[tuple[str, str, int]] = []
+    for mod in modules.values():
+        if mod.name.split(".")[1:2] == ["analysis"]:
+            continue  # the analyzers talk ABOUT metric names
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _METRIC_RE.fullmatch(node.value)):
+                metric_literals.append(
+                    (node.value, mod.display, node.lineno))
+
+    doc_metrics: dict[str, int] = {}
+    doc_display = ""
+    if doc_path is not None and Path(doc_path).is_file():
+        doc_path = Path(doc_path)
+        doc_display = _display(doc_path)
+        for i, text in enumerate(
+                doc_path.read_text(encoding="utf-8").splitlines(), start=1):
+            for token in _DOC_METRIC_RE.findall(text):
+                for name in _expand_doc_token(token):
+                    doc_metrics.setdefault(name, i)
+    else:
+        doc_path = None
+
+    return ProgramGraph(
+        package=package, root=package_root, modules=modules,
+        import_edges=edges, functions=functions,
+        wake_annotations=annotations, wake_producers=producers,
+        metric_literals=metric_literals, doc_path=doc_path,
+        doc_display=doc_display, doc_metrics=doc_metrics)
+
+
+# -------------------------------------------------------------------- rules
+
+RawFinding = tuple[str, int, str]          # (display path, line, message)
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphRule:
+    id: str
+    name: str
+    doc: str
+    fn: Callable[[ProgramGraph], list[RawFinding]]
+
+
+# The paper's layer map (SURVEY §1): L5 operator → L4 controllers → L3
+# cloudprovider → L2 instance provider → L1 cloud client/auth. Foundation
+# modules (apis/errors/catalog/scheduling/transport/auth) sit below the
+# whole stack; test/support trees (fake, envtest, chaos, analysis,
+# observability, models/ops/parallel workload code) are outside it.
+_LAYERS = {"runtime": 1, "providers": 2, "cloudprovider": 3,
+           "controllers": 4, "operator": 5}
+# Segments runtime/ must never import — everything layered above it, plus
+# the support trees that themselves import the control plane.
+_ABOVE_RUNTIME = {"providers", "cloudprovider", "controllers", "operator",
+                  "chaos", "envtest", "fake", "observability", "analysis"}
+# The ROADMAP item-4 provider seam: cloud-specific modules only the
+# provider layer itself (and the operator composition root) may import.
+_CLOUD_SPECIFIC = ("providers.gcp", "providers.rest")
+
+
+def check_layering(g: ProgramGraph) -> list[RawFinding]:
+    cloud_specific = {f"{g.package}.{m}" for m in _CLOUD_SPECIFIC}
+    out: list[RawFinding] = []
+    for e in g.import_edges:
+        src_seg, dst_seg = g.segment(e.src), g.segment(e.dst)
+        mod = g.modules[e.src]
+        if src_seg == "runtime" and dst_seg in _ABOVE_RUNTIME:
+            out.append((mod.display, e.line, (
+                f"runtime/ imports {e.dst}: the runtime layer sits below "
+                f"the whole control plane (SURVEY §1 layer map) and must "
+                f"import nothing above itself")))
+        elif (src_seg in ("controllers", "cloudprovider", "runtime")
+                and e.dst in cloud_specific):
+            out.append((mod.display, e.line, (
+                f"{src_seg}/ imports cloud-specific module {e.dst}: "
+                f"everything above the instance-provider seam must stay "
+                f"cloud-neutral (ROADMAP item 4 — the second-backend "
+                f"refactor needs this seam clean)")))
+        elif src_seg == "providers" and dst_seg in ("controllers",
+                                                    "operator"):
+            out.append((mod.display, e.line, (
+                f"providers/ imports {e.dst}: the provider layer must not "
+                f"depend on the control loops above it (dependencies point "
+                f"down the SURVEY §1 layer map)")))
+        elif dst_seg == "operator" and src_seg != "operator":
+            out.append((mod.display, e.line, (
+                f"{e.src} imports {e.dst}: operator/ is the composition "
+                f"root (L5) — nothing imports the binary")))
+    return out
+
+
+def check_wake_graph(g: ProgramGraph) -> list[RawFinding]:
+    out: list[RawFinding] = []
+    for a in g.wake_annotations:
+        if a.source not in g.wake_producers:
+            out.append((a.display, a.line, (
+                f"`# wakes: {a.source}` declares an event-driven wake "
+                f"edge, but no call site in the package produces source "
+                f"'{a.source}' (WakeHub.wake/wake_after, Controller."
+                f"inject, a workqueue enqueue source=..., or a watch "
+                f"wake_source=...) — a declared-but-unproduced edge means "
+                f"this park only ever ends on its safety-net timer, the "
+                f"bug class the wake graph exists to kill")))
+    return out
+
+
+def check_fence_flow(g: ProgramGraph) -> list[RawFinding]:
+    # Fixpoint over the module-local call graph: a function "leaks" when a
+    # mutation is reachable from its entry with no fence check first —
+    # either a direct unfenced mutation or an unfenced call into a leaking
+    # callee. PL003 already flags direct sites in their own function; this
+    # rule flags the CALLERS of helpers that launder the mutation (helpers
+    # whose own PL003 finding was waived with "caller holds the fence").
+    provider_funcs = {q: f for q, f in g.functions.items()
+                      if g.segment(f.module) == "providers"}
+
+    def first_unfenced_site(f: FunctionInfo,
+                            leaking: set[str]) -> Optional[int]:
+        sites = list(f.mutation_lines)
+        sites += [ln for callee, ln in f.calls if callee in leaking]
+        if not sites:
+            return None
+        first = min(sites)
+        if f.fence_lines and min(f.fence_lines) < first:
+            return None
+        return first
+
+    leaking: set[str] = set()
+    for _ in range(len(provider_funcs) + 1):
+        nxt = {q for q, f in provider_funcs.items()
+               if first_unfenced_site(f, leaking) is not None}
+        if nxt == leaking:
+            break
+        leaking = nxt
+
+    out: list[RawFinding] = []
+    for q, f in provider_funcs.items():
+        for callee, line in f.calls:
+            if callee not in leaking:
+                continue
+            if f.fence_lines and min(f.fence_lines) < line:
+                continue  # the caller's fence covers the laundered path
+            helper = callee.split(":", 1)[1]
+            out.append((f.display, line, (
+                f"call into {helper}() reaches a cloud mutation with no "
+                f"fence check on the path (neither inside the helper nor "
+                f"before this call) — interprocedural PL003: a deposed "
+                f"leader could mutate the cloud through this laundered "
+                f"path")))
+    return out
+
+
+def check_metrics_docs(g: ProgramGraph) -> list[RawFinding]:
+    if g.doc_path is None:
+        return []
+    out: list[RawFinding] = []
+    seen: set[str] = set()
+    for name, display, line in g.metric_literals:
+        if name in g.doc_metrics or name in seen:
+            continue
+        seen.add(name)
+        out.append((display, line, (
+            f"metric family {name} is registered in code but absent from "
+            f"{g.doc_display} — the catalog is the triage entry point; an "
+            f"undocumented family is invisible at 2am")))
+    code_names = {name for name, _, _ in g.metric_literals}
+    for name, line in sorted(g.doc_metrics.items()):
+        if name not in code_names:
+            out.append((g.doc_display, line, (
+                f"{g.doc_display} documents metric {name} but nothing in "
+                f"the package registers it — stale docs misdirect an "
+                f"incident responder")))
+    return out
+
+
+RULES: list[GraphRule] = [
+    GraphRule("PG001", "layering-violation",
+              "import edge against the SURVEY §1 layer DAG (runtime "
+              "imports nothing above itself; cloud-specific modules stay "
+              "below the provider seam; providers never import "
+              "controllers; nothing imports operator/)", check_layering),
+    GraphRule("PG002", "unproduced-wake-edge",
+              "a `# wakes: <source>` annotation with no producer call "
+              "site for that source anywhere in the package",
+              check_wake_graph),
+    GraphRule("PG003", "unfenced-mutation-path",
+              "a call into a helper that transitively issues a cloud "
+              "mutation, with no fence check inside the helper or before "
+              "the call (interprocedural PL003)", check_fence_flow),
+    GraphRule("PG004", "metrics-docs-drift",
+              "tpu_provisioner_* names in code and docs/OBSERVABILITY.md "
+              "must match exactly, both directions", check_metrics_docs),
+]
+
+
+# ------------------------------------------------------------------- runner
+
+def _known_keys(rules: list[GraphRule]) -> set[str]:
+    keys: set[str] = set()
+    for r in rules:
+        keys.add(r.id.lower())
+        keys.add(r.name.lower())
+    return keys
+
+
+def analyze(package_root: Path, doc_path: Optional[Path] = None,
+            rules: Optional[list[GraphRule]] = None) -> list[Finding]:
+    """Build the graph, run the rules, apply per-file provgraph waivers.
+
+    Doc-side findings (PG004's second direction) have no waiver channel —
+    the fix is editing the doc, which is always available."""
+    rules = RULES if rules is None else rules
+    g = build_graph(Path(package_root), doc_path)
+    raw: list[tuple[GraphRule, RawFinding]] = []
+    seen: set[tuple[str, str, int]] = set()
+    for rule in rules:
+        for f in rule.fn(g):
+            # One finding per (rule, file, line): a from-import records both
+            # the base and the alias-refined edge, which are the same
+            # violation at the same line.
+            sig = (rule.id, f[0], f[1])
+            if sig in seen:
+                continue
+            seen.add(sig)
+            raw.append((rule, f))
+
+    known = _known_keys(RULES)
+    waivers = {mod.display: parse_waivers(
+        mod.lines, known, _comment_lines(mod.source), tag=WAIVER_TAG)
+        for mod in g.modules.values()}
+
+    findings: list[Finding] = []
+    for display, w in waivers.items():
+        findings.extend(
+            Finding("PG000", "malformed-waiver", display, line, msg)
+            for line, msg in w.malformed)
+    for rule, (display, line, msg) in raw:
+        w = waivers.get(display)
+        if w is not None and w.waived(rule, line):  # type: ignore[arg-type]
+            continue
+        findings.append(Finding(rule.id, rule.name, display, line, msg))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+# ---------------------------------------------------------------------- CLI
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="provgraph",
+        description="Whole-program invariant analysis for the provisioner "
+                    "control plane (docs/STATIC_ANALYSIS.md#provgraph).")
+    ap.add_argument("root", nargs="?", default="gpu_provisioner_tpu",
+                    help="package root to analyze")
+    ap.add_argument("--docs", default=DEFAULT_DOC,
+                    help="metrics catalog doc for PG004 (default: "
+                         f"{DEFAULT_DOC}; pass an empty string to skip)")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE", help="run only these rules (id or name)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id}  {r.name:<26} {r.doc}")
+        return 0
+
+    rules = RULES
+    if args.select:
+        keys = {s.lower() for s in args.select}
+        rules = [r for r in RULES
+                 if r.id.lower() in keys or r.name.lower() in keys]
+        if not rules:
+            print(f"provgraph: no rule matches {sorted(keys)}",
+                  file=sys.stderr)
+            return 2
+
+    root = Path(args.root)
+    if not root.is_dir():
+        print(f"provgraph: no such package root: {root}", file=sys.stderr)
+        return 2
+    doc = Path(args.docs) if args.docs else None
+
+    findings = analyze(root, doc, rules=rules)
+    if args.as_json:
+        print(json.dumps([dataclasses.asdict(f) for f in findings],
+                         indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"provgraph: {len(findings)} finding(s), "
+              f"{len(rules)} rule(s) active", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,10 +156,21 @@ class Rule:
 
 # ------------------------------------------------------------------ waivers
 
-_WAIVER_RE = re.compile(
-    r"#\s*provlint:\s*(disable|disable-file)\s*=\s*"
-    r"([A-Za-z0-9_\-, ]+?)\s*(?:—|--)\s*(\S.*)$")
-_WAIVER_MARK_RE = re.compile(r"#\s*provlint\s*:")
+# Waiver syntax is shared with provgraph (same grammar, different comment
+# tag — "provgraph" instead of "provlint"), so the regexes are built per tag.
+_WAIVER_RES: dict[str, tuple[re.Pattern, re.Pattern]] = {}
+
+
+def _waiver_res(tag: str) -> tuple[re.Pattern, re.Pattern]:
+    pair = _WAIVER_RES.get(tag)
+    if pair is None:
+        pair = (
+            re.compile(
+                rf"#\s*{tag}:\s*(disable|disable-file)\s*=\s*"
+                r"([A-Za-z0-9_\-, ]+?)\s*(?:—|--)\s*(\S.*)$"),
+            re.compile(rf"#\s*{tag}\s*:"))
+        _WAIVER_RES[tag] = pair
+    return pair
 
 
 @dataclasses.dataclass
@@ -197,7 +208,9 @@ def _comment_lines(source: str) -> Optional[set[int]]:
 
 
 def parse_waivers(lines: list[str], known: set[str],
-                  comment_lines: Optional[set[int]] = None) -> Waivers:
+                  comment_lines: Optional[set[int]] = None,
+                  tag: str = "provlint") -> Waivers:
+    waiver_re, mark_re = _waiver_res(tag)
     by_line: dict[int, set[str]] = {}
     exact: dict[int, set[str]] = {}
     file_wide: set[str] = set()
@@ -205,13 +218,13 @@ def parse_waivers(lines: list[str], known: set[str],
     for i, text in enumerate(lines, start=1):
         if comment_lines is not None and i not in comment_lines:
             continue
-        if not _WAIVER_MARK_RE.search(text):
+        if not mark_re.search(text):
             continue
-        m = _WAIVER_RE.search(text)
+        m = waiver_re.search(text)
         if m is None:
             malformed.append((i, (
                 "malformed waiver: expected disable=<rule> — <reason> "
-                "after the provlint marker (the reason is mandatory)")))
+                f"after the {tag} marker (the reason is mandatory)")))
             continue
         kind, rules_raw, _reason = m.groups()
         keys = {r.strip().lower() for r in rules_raw.split(",") if r.strip()}
@@ -320,6 +333,38 @@ def iter_py_files(paths: Iterable[Path]) -> Iterable[Path]:
                 yield f
 
 
+def changed_py_files(paths: Iterable[Path]) -> list[Path]:
+    """The ``--changed`` file set: ``git diff --name-only HEAD`` (modified,
+    tracked) plus untracked files, narrowed to existing ``.py`` files under
+    ``paths`` — the fast pre-commit loop. Raises ``OSError`` /
+    ``CalledProcessError`` when git is unavailable or the cwd is not a
+    repository; fixture-corpus files are excluded exactly as in the
+    full-tree walk."""
+    import subprocess
+
+    def git(*argv: str) -> str:
+        return subprocess.run(["git", *argv], capture_output=True,
+                              text=True, check=True).stdout
+
+    root = Path(git("rev-parse", "--show-toplevel").strip())
+    names = set(git("diff", "--name-only", "HEAD").splitlines())
+    names |= set(git("ls-files", "--others",
+                     "--exclude-standard").splitlines())
+    scopes = [Path(p).resolve() for p in paths]
+    out: list[Path] = []
+    for name in sorted(names):
+        f = root / name
+        if f.suffix != ".py" or not f.is_file():
+            continue  # deleted files still appear in the diff
+        if FIXTURE_DIR in f.parts:
+            continue
+        rf = f.resolve()
+        if scopes and not any(rf == s or s in rf.parents for s in scopes):
+            continue
+        out.append(f)
+    return out
+
+
 def lint_paths(paths: Iterable[Path],
                rules: Optional[list[Rule]] = None) -> list[Finding]:
     findings: list[Finding] = []
@@ -345,6 +390,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="emit findings as JSON")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs HEAD (git diff "
+                         "--name-only + untracked) under the given paths — "
+                         "the fast pre-commit loop; rules and waiver "
+                         "semantics are identical to the full walk")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -368,7 +418,15 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"provlint: no such path: {missing}", file=sys.stderr)
         return 2
 
-    files = list(iter_py_files(Path(p) for p in args.paths))
+    if args.changed:
+        try:
+            files = changed_py_files(Path(p) for p in args.paths)
+        except Exception as e:  # noqa: BLE001 — git missing / not a repo
+            print(f"provlint: --changed needs a git checkout: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        files = list(iter_py_files(Path(p) for p in args.paths))
     findings: list[Finding] = []
     for f in files:
         findings.extend(lint_file(f, rules=rules))

@@ -1,0 +1,565 @@
+"""schedfuzz: seeded deterministic interleaving exploration.
+
+provlint checks what the code *says*; provgraph checks how the modules
+*relate*; neither can see the bug class PR 11 actually shipped and
+reverted twice in review — orderings. "Cache-apply before handler
+delivery", "meta patch before status patch", "fence check before cloud
+mutate", "hub stopped means no more wakes" are all happens-before
+contracts: every individual statement is correct, and the defect only
+exists in the *schedule* — which asyncio callback ran first. The default
+event loop is FIFO, so the buggy schedule may essentially never occur on a
+developer laptop and then occur at fleet scale under load.
+
+schedfuzz makes the schedule an input:
+
+- :class:`SchedFuzzLoop` is a drop-in ``SelectorEventLoop`` whose
+  ``call_soon`` perturbs the ready queue with a seeded RNG — sometimes the
+  newly scheduled callback jumps the queue, sometimes a victim already in
+  the queue is pushed to the back (a forced yield). Same seed + same
+  scenario → same decision stream.
+- The probe seam (:mod:`..runtime.probes`) records the ordering-relevant
+  events while a scenario runs: ``cache-apply`` / ``handler-delivery``
+  (informer relay), ``wq-enqueue`` / ``wq-timer-due`` / ``wq-stale-drop``
+  (workqueue epoch guard), ``fence-check`` / ``cloud-mutate`` (leader
+  fence), ``meta-patch`` / ``status-patch`` (status writer), ``hub-wake``
+  / ``hub-stop`` (wake hub). Probes are module-global and disarmed by
+  default — production pays one ``is None`` check per site.
+- The happens-before checkers (:data:`CHECKERS`) replay the recorded event
+  stream and assert each contract. A violated contract is reported with
+  the event index and a human diagnosis.
+- :func:`explore` sweeps a seed range; any failing seed is written as a
+  **replay file** (JSON: scenario name, seed, perturbation probability,
+  decision trace, violations) and :func:`replay` re-runs it. The RNG
+  stream is fully determined by the seed, so re-running the scenario with
+  the replay file's seed re-derives the same decision sequence whenever
+  the scenario itself is deterministic; the envtest scenarios use
+  wall-clock timers, so the guarantee in practice is "the same seed finds
+  the same violation", which the mutation tests in
+  tests/test_schedfuzz.py pin down.
+
+Run it: ``make fuzz`` (seed budget via ``FUZZ_SEEDS``), or directly::
+
+    python -m gpu_provisioner_tpu.analysis.schedfuzz --seeds 25
+    python -m gpu_provisioner_tpu.analysis.schedfuzz --replay \\
+        .schedfuzz/replay-wave-seed7.json
+
+See docs/STATIC_ANALYSIS.md#schedfuzz for the catalog of contracts and
+how to write a scenario.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import random
+import sys
+from collections import Counter, deque
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+from ..runtime import probes
+
+DEFAULT_SEEDS = 20
+DEFAULT_PERTURB = 0.25
+DEFAULT_TIMEOUT = 60.0
+DEFAULT_REPLAY_DIR = ".schedfuzz"
+REPLAY_FORMAT = "schedfuzz-replay/1"
+
+
+# --------------------------------------------------------------- loop shim
+
+class SchedFuzzLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop with a seeded ready-queue perturber.
+
+    Every ``call_soon`` may (with probability ``perturb_prob``) reorder the
+    loop's ready queue: promote the new handle to the front, or rotate an
+    already-queued handle to the back. Both are schedules plain asyncio is
+    allowed to produce across versions/platforms/load — the shim only
+    *chooses* among legal interleavings, it never drops or duplicates a
+    callback, so a violation found here is a real program bug, not an
+    artifact. Timer ordering (``call_at``) is untouched: timers enter the
+    ready queue through ``_run_once`` and their relative deadline order is
+    part of the loop contract; what the shim varies is who runs first once
+    several callbacks are runnable, which is exactly the freedom production
+    load exercises.
+
+    Decisions are recorded as ``(call_index, op, arg)`` triples (op 1 =
+    new-handle-to-front, op 2 = victim ``arg`` rotated to back) — the
+    replay file carries them for diagnosis.
+    """
+
+    def __init__(self, seed: int, perturb_prob: float = DEFAULT_PERTURB):
+        super().__init__()
+        self.seed = seed
+        self.perturb_prob = perturb_prob
+        self._rng = random.Random(seed)
+        self.call_soon_total = 0
+        self.perturbed_total = 0
+        self.decisions: list[tuple[int, int, int]] = []
+        # _ready is a CPython BaseEventLoop internal; if it ever changes
+        # shape, degrade to a plain (un-perturbed) loop rather than crash.
+        self._fuzz_armed = isinstance(getattr(self, "_ready", None), deque)
+
+    def call_soon(self, callback, *args, context=None):
+        handle = super().call_soon(callback, *args, context=context)
+        if self._fuzz_armed:
+            self._perturb()
+        return handle
+
+    def _perturb(self) -> None:
+        self.call_soon_total += 1
+        rng = self._rng
+        # rng.random() is consumed unconditionally so the decision stream
+        # depends only on the call_soon sequence, not on queue depth.
+        roll = rng.random()
+        ready = self._ready
+        if roll >= self.perturb_prob or len(ready) < 2:
+            return
+        if rng.randrange(2) == 0:
+            # the newcomer (tail) jumps the whole queue
+            ready.appendleft(ready.pop())
+            self.decisions.append((self.call_soon_total, 1, 0))
+        else:
+            # a victim already queued is pushed behind the newcomer — the
+            # forced-yield schedule
+            victim = rng.randrange(len(ready) - 1)
+            h = ready[victim]
+            del ready[victim]
+            ready.append(h)
+            self.decisions.append((self.call_soon_total, 2, victim))
+        self.perturbed_total += 1
+
+
+# ---------------------------------------------------------------- recorder
+
+@dataclasses.dataclass
+class FuzzEvent:
+    seq: int
+    name: str
+    key: object
+    task: Optional[str]          # "Task-7#7f3a..." — fence scoping
+    info: dict
+
+
+class TraceRecorder:
+    """The probe sink: records every emitted event with its sequence
+    number and the asyncio task it fired on (probes fire synchronously, so
+    this IS program order on the loop)."""
+
+    def __init__(self) -> None:
+        self.events: list[FuzzEvent] = []
+
+    def __call__(self, event: str, key, **info) -> None:
+        try:
+            t = asyncio.current_task()
+        except RuntimeError:
+            t = None
+        task = None if t is None else f"{t.get_name()}#{id(t):x}"
+        self.events.append(
+            FuzzEvent(len(self.events), event, key, task, info))
+
+
+# ---------------------------------------------------- happens-before rules
+
+@dataclasses.dataclass
+class Violation:
+    checker: str
+    seq: int
+    message: str
+
+
+def check_cache_before_deliver(events: list[FuzzEvent]) -> list[Violation]:
+    """A controller handler must never be handed a watch event its informer
+    cache cannot serve yet (RelayWatch's post-cache-apply ordering,
+    controller-runtime parity). Counted per object key: at any handler
+    delivery, the cache must have applied at least as many updates for that
+    key as this controller has been handed. Kinds that never produce a
+    ``cache-apply`` are uncached (raw watches) and exempt."""
+    cached_kinds = {e.key[0] for e in events if e.name == "cache-apply"}
+    applies: Counter = Counter()
+    delivered: Counter = Counter()
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "cache-apply":
+            applies[e.key] += 1
+        elif e.name == "handler-delivery" and e.key[0] in cached_kinds:
+            slot = (e.info.get("controller"), e.key)
+            delivered[slot] += 1
+            if delivered[slot] > applies[e.key]:
+                out.append(Violation(
+                    "cache-before-deliver", e.seq,
+                    f"controller {slot[0]!r} handed delivery "
+                    f"#{delivered[slot]} for {e.key} but its cache has "
+                    f"applied only {applies[e.key]} update(s) — the handler "
+                    f"can read stale cache for the object it was woken "
+                    f"for (post-cache-apply relay ordering broken)"))
+    return out
+
+
+def check_stale_timer_requeue(events: list[FuzzEvent]) -> list[Violation]:
+    """A safety-net timer that fires stale (the item's wake epoch moved on
+    while it was parked) must be DROPPED, never enqueued: the wake that
+    bumped the epoch already ran the reconcile, and re-firing the old
+    timer is the spurious double-reconcile the epoch guard exists to
+    kill."""
+    pending: dict = {}
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "wq-timer-due" and e.info.get("stale"):
+            pending[e.key] = e.seq
+        elif e.name == "wq-stale-drop":
+            pending.pop(e.key, None)
+        elif e.name == "wq-enqueue":
+            if e.key in pending and e.info.get("source") == "timer":
+                out.append(Violation(
+                    "stale-timer-requeue", e.seq,
+                    f"workqueue item {e.key!r} came due STALE (armed at an "
+                    f"older wake epoch) but was enqueued as a timer wake "
+                    f"instead of dropped — the epoch guard is not holding "
+                    f"and every event wake costs a spurious extra "
+                    f"reconcile"))
+            pending.pop(e.key, None)
+    return out
+
+
+def check_fence_before_mutate(events: list[FuzzEvent]) -> list[Violation]:
+    """Every cloud mutation must be preceded, on the same asyncio task, by
+    a leadership fence check — the interleaving form of provlint PL003 /
+    provgraph PG003: the static rules prove a check exists in the code
+    path, this proves one actually RAN before the call left the
+    process."""
+    fenced: set = set()
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "fence-check" and e.task is not None:
+            fenced.add(e.task)
+        elif e.name == "cloud-mutate":
+            if e.task is None or e.task not in fenced:
+                out.append(Violation(
+                    "fence-before-mutate", e.seq,
+                    f"cloud mutation {e.key} issued on task {e.task} with "
+                    f"no fence check earlier on that task — a deposed "
+                    f"leader could still mutate the cloud"))
+    return out
+
+
+def check_meta_before_status(events: list[FuzzEvent]) -> list[Violation]:
+    """Per claim: the status patch never outruns the meta patch (Ready must
+    not be observable while launch-merged labels are unwritten — the
+    ``write_claim_patches`` invariant, here checked across every writer
+    and interleaving rather than inside one call)."""
+    meta: Counter = Counter()
+    status: Counter = Counter()
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "meta-patch":
+            meta[e.key] += 1
+        elif e.name == "status-patch":
+            status[e.key] += 1
+            if status[e.key] > meta[e.key]:
+                out.append(Violation(
+                    "meta-before-status", e.seq,
+                    f"claim {e.key!r} status patch #{status[e.key]} landed "
+                    f"with only {meta[e.key]} meta patch(es) written — a "
+                    f"watcher can observe conditions (incl. Ready) before "
+                    f"the launch-merged labels exist"))
+    return out
+
+
+def check_stop_before_late_wake(events: list[FuzzEvent]) -> list[Violation]:
+    """After ``WakeHub.stop()`` no wake may deliver from that hub — a late
+    wake would enqueue into a workqueue that is shutting down (the PL007
+    teardown-leak bug class, caught as an ordering instead of a leak)."""
+    stopped: set = set()
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "hub-stop":
+            stopped.add(e.key)
+        elif e.name == "hub-wake" and e.key in stopped:
+            out.append(Violation(
+                "stop-before-late-wake", e.seq,
+                f"WakeHub {e.key} delivered wake {e.info.get('name')!r} "
+                f"(source={e.info.get('source')!r}) after stop() — "
+                f"teardown does not quiesce the wake graph"))
+    return out
+
+
+CHECKERS: dict[str, Callable[[list[FuzzEvent]], list[Violation]]] = {
+    "cache-before-deliver": check_cache_before_deliver,
+    "stale-timer-requeue": check_stale_timer_requeue,
+    "fence-before-mutate": check_fence_before_mutate,
+    "meta-before-status": check_meta_before_status,
+    "stop-before-late-wake": check_stop_before_late_wake,
+}
+
+
+# ------------------------------------------------------------------ runner
+
+@dataclasses.dataclass
+class FuzzResult:
+    scenario: str
+    seed: int
+    perturb_prob: float
+    events: list[FuzzEvent]
+    violations: list[Violation]
+    decisions: list[tuple[int, int, int]]
+    call_soon_total: int
+    perturbed_total: int
+    error: Optional[str] = None
+    replay_path: Optional[Path] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and self.error is None
+
+
+def run_scenario(scenario: Callable[[], object], seed: int, *,
+                 name: Optional[str] = None,
+                 checkers: Optional[dict] = None,
+                 perturb_prob: float = DEFAULT_PERTURB,
+                 timeout: float = DEFAULT_TIMEOUT) -> FuzzResult:
+    """Run one scenario coroutine under a perturbed loop with the probe
+    seam armed; replay the recorded events through the checkers.
+
+    The scenario runs on a private :class:`SchedFuzzLoop` (installed as the
+    thread's loop for the duration, restored after); a scenario exception
+    is captured into ``result.error`` — an interleaving-induced crash is a
+    finding, not a harness failure.
+    """
+    checkers = CHECKERS if checkers is None else checkers
+    loop = SchedFuzzLoop(seed, perturb_prob)
+    rec = TraceRecorder()
+    prev = probes.arm(rec)
+    error: Optional[str] = None
+    asyncio.set_event_loop(loop)
+    try:
+        try:
+            loop.run_until_complete(
+                asyncio.wait_for(scenario(), timeout=timeout))
+        except Exception as exc:  # noqa: BLE001 — captured as a finding
+            error = f"{type(exc).__name__}: {exc}"
+    finally:
+        probes.disarm(prev)
+        try:
+            _drain(loop)
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()
+    violations: list[Violation] = []
+    for fn in checkers.values():
+        violations.extend(fn(rec.events))
+    violations.sort(key=lambda v: v.seq)
+    return FuzzResult(
+        scenario=name or getattr(scenario, "__name__", "scenario"),
+        seed=seed, perturb_prob=perturb_prob, events=rec.events,
+        violations=violations, decisions=loop.decisions,
+        call_soon_total=loop.call_soon_total,
+        perturbed_total=loop.perturbed_total, error=error)
+
+
+def _drain(loop: asyncio.AbstractEventLoop) -> None:
+    pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+    for t in pending:
+        t.cancel()
+    if pending:
+        loop.run_until_complete(
+            asyncio.gather(*pending, return_exceptions=True))
+    loop.run_until_complete(loop.shutdown_asyncgens())
+
+
+def explore(scenario: Callable[[], object], *, name: Optional[str] = None,
+            seeds: Iterable[int] = range(DEFAULT_SEEDS),
+            perturb_prob: float = DEFAULT_PERTURB,
+            checkers: Optional[dict] = None,
+            replay_dir: Optional[object] = None,
+            stop_on_first: bool = False,
+            timeout: float = DEFAULT_TIMEOUT) -> list[FuzzResult]:
+    """Seed sweep: run the scenario once per seed; failing seeds get a
+    replay file in ``replay_dir`` (when given). ``stop_on_first`` returns
+    as soon as one seed fails — the mutation tests use it so the seed
+    budget is an upper bound, not a fixed cost."""
+    results: list[FuzzResult] = []
+    for seed in seeds:
+        res = run_scenario(scenario, seed, name=name, checkers=checkers,
+                           perturb_prob=perturb_prob, timeout=timeout)
+        results.append(res)
+        if not res.ok:
+            if replay_dir is not None:
+                res.replay_path = write_replay(res, replay_dir)
+            if stop_on_first:
+                break
+    return results
+
+
+# -------------------------------------------------------------- replay I/O
+
+def write_replay(result: FuzzResult, out_dir) -> Path:
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"replay-{result.scenario}-seed{result.seed}.json"
+    payload = {
+        "format": REPLAY_FORMAT,
+        "scenario": result.scenario,
+        "seed": result.seed,
+        "perturb_prob": result.perturb_prob,
+        "call_soon_total": result.call_soon_total,
+        "perturbed_total": result.perturbed_total,
+        "decisions": [list(d) for d in result.decisions],
+        "violations": [dataclasses.asdict(v) for v in result.violations],
+        "error": result.error,
+        "repro": ("python -m gpu_provisioner_tpu.analysis.schedfuzz "
+                  f"--replay {path}"),
+    }
+    path.write_text(json.dumps(payload, indent=2, default=str) + "\n",
+                    encoding="utf-8")
+    return path
+
+
+def replay(path, *, scenarios: Optional[dict] = None,
+           checkers: Optional[dict] = None,
+           timeout: float = DEFAULT_TIMEOUT) -> FuzzResult:
+    """Re-run the scenario+seed a replay file records. The decision trace
+    in the file is diagnostic — the rerun re-derives it from the seed."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if data.get("format") != REPLAY_FORMAT:
+        raise ValueError(f"{path}: not a {REPLAY_FORMAT} file")
+    scenarios = SCENARIOS if scenarios is None else scenarios
+    fn = scenarios.get(data["scenario"])
+    if fn is None:
+        raise ValueError(f"{path}: unknown scenario {data['scenario']!r}")
+    return run_scenario(fn, data["seed"], name=data["scenario"],
+                        checkers=checkers,
+                        perturb_prob=data.get("perturb_prob",
+                                              DEFAULT_PERTURB),
+                        timeout=timeout)
+
+
+# ------------------------------------------------------ built-in scenarios
+
+def fuzz_options(**overrides):
+    """Envtest options tuned for interleaving density, not realism: tiny
+    latencies so many callbacks are runnable at once (more schedules to
+    choose among per seed), detectors' stall budget off (the perturber
+    deliberately delays callbacks; that is the point, not a stall)."""
+    from ..envtest import EnvtestOptions
+    base = dict(
+        use_informer=True,
+        create_latency=0.01, delete_latency=0.01, qr_step_latency=0.0,
+        node_join_delay=0.0, node_ready_delay=0.0,
+        node_wait_interval=0.01,
+        instance_cache_ttl=0.05, instance_cache_negative_ttl=0.02,
+        gc_interval=0.5, leak_grace=0.5,
+        stall_budget=0.0,
+    )
+    base.update(overrides)
+    return EnvtestOptions(**base)
+
+
+async def scenario_wave() -> None:
+    """Small provisioning wave through the informer-cached wiring — the
+    densest ordering surface: relay fanout, LRO wakes, status batching,
+    fence-checked creates, teardown quiesce."""
+    from ..envtest import Env
+    from ..fake import make_nodeclaim
+    async with Env(fuzz_options()) as env:
+        names = [f"fz{i}" for i in range(3)]
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        for n in names:
+            await env.wait_ready(n)
+
+
+async def scenario_churn() -> None:
+    """Provision, deprovision mid-flight, provision again: exercises the
+    delete path's fences, stale safety-net timers (the woken claim's
+    parked requeues), and late-wake pressure at teardown."""
+    from ..apis.karpenter import NodeClaim
+    from ..envtest import Env
+    from ..fake import make_nodeclaim
+    async with Env(fuzz_options()) as env:
+        await env.client.create(make_nodeclaim("fz-keep"))
+        await env.client.create(make_nodeclaim("fz-churn"))
+        await env.wait_ready("fz-keep")
+        await env.wait_ready("fz-churn")
+        await env.client.delete(NodeClaim, "fz-churn")
+        await env.wait_gone("fz-churn")
+        await env.client.create(make_nodeclaim("fz-late"))
+        await env.wait_ready("fz-late")
+
+
+SCENARIOS: dict[str, Callable[[], object]] = {
+    "wave": scenario_wave,
+    "churn": scenario_churn,
+}
+
+
+# --------------------------------------------------------------------- CLI
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="schedfuzz",
+        description="Seeded interleaving explorer for the provisioner's "
+                    "happens-before contracts "
+                    "(docs/STATIC_ANALYSIS.md#schedfuzz).")
+    ap.add_argument("--scenario", action="append", default=None,
+                    choices=sorted(SCENARIOS), metavar="NAME",
+                    help="scenario(s) to sweep (default: all): "
+                         + ", ".join(sorted(SCENARIOS)))
+    ap.add_argument("--seeds", type=int, default=DEFAULT_SEEDS,
+                    help=f"seed budget per scenario (default "
+                         f"{DEFAULT_SEEDS})")
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed of the sweep (default 0)")
+    ap.add_argument("--perturb", type=float, default=DEFAULT_PERTURB,
+                    help=f"per-call_soon perturbation probability "
+                         f"(default {DEFAULT_PERTURB})")
+    ap.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT,
+                    help="per-run scenario timeout in seconds")
+    ap.add_argument("--replay-dir", default=DEFAULT_REPLAY_DIR,
+                    help="where failing seeds' replay files go "
+                         f"(default {DEFAULT_REPLAY_DIR}/)")
+    ap.add_argument("--replay", metavar="FILE",
+                    help="re-run one replay file instead of sweeping")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        res = replay(args.replay, timeout=args.timeout)
+        _print_failures(res)
+        state = "reproduced" if not res.ok else "did NOT reproduce"
+        print(f"schedfuzz replay {args.replay}: scenario={res.scenario} "
+              f"seed={res.seed} — failure {state}")
+        return 0 if not res.ok else 1
+
+    names = args.scenario or sorted(SCENARIOS)
+    seeds = range(args.seed_base, args.seed_base + args.seeds)
+    rc = 0
+    for nm in names:
+        results = explore(SCENARIOS[nm], name=nm, seeds=seeds,
+                          perturb_prob=args.perturb,
+                          replay_dir=args.replay_dir,
+                          timeout=args.timeout)
+        bad = [r for r in results if not r.ok]
+        print(f"schedfuzz {nm}: {len(results)} seed(s), "
+              f"{sum(len(r.events) for r in results)} events, "
+              f"{sum(r.perturbed_total for r in results)} perturbations, "
+              f"{len(bad)} failing seed(s)")
+        for r in bad:
+            rc = 1
+            _print_failures(r)
+            if r.replay_path is not None:
+                print(f"  replay file: {r.replay_path}")
+    return rc
+
+
+def _print_failures(res: FuzzResult) -> None:
+    for v in res.violations:
+        print(f"  seed {res.seed} event {v.seq}: [{v.checker}] "
+              f"{v.message}")
+    if res.error:
+        print(f"  seed {res.seed}: scenario error: {res.error}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,10 @@ class NodeClaimLifecycleController:
         if error is not None:
             raise error
         # wakes: aggregate — min of the sub-reconcilers' annotated waits
+        # provgraph: disable=PG002 — 'aggregate' is not a wake SOURCE: each
+        # folded requeue_after carries its own `# wakes:` annotation at the
+        # sub-reconciler site, and those are the edges PG002 checks; this
+        # line only documents the min() fold
         return Result(requeue_after=min(requeues) if requeues else None,
                       preserve_failures=preserve)
 

@@ -41,6 +41,10 @@ from typing import Optional
 
 from ..apis.karpenter import LAUNCHED, NodeClaim
 from ..errors import NodeClaimNotFoundError
+# provgraph: disable=PG001 — the recovery scan classifies orphaned pools by
+# GCP nodepool/QR state constants that still live in the cloud module;
+# hoisting a cloud-neutral state enum behind the provider seam is exactly
+# the ROADMAP item-4 second-backend refactor, tracked there
 from ..providers.gcp import (
     NP_ERROR, NP_PROVISIONING, NP_STOPPING, QR_ACTIVE,
 )

@@ -50,7 +50,7 @@ import logging
 from typing import Optional
 
 from ..apis.karpenter import NodeClaim
-from ..runtime import NotFoundError
+from ..runtime import NotFoundError, probes
 from ..runtime.client import Client, ConflictError, patch_retry
 from ..runtime.wakehub import SOURCE_STATUS_FLUSH
 
@@ -101,8 +101,10 @@ async def write_claim_patches(client: Client, nc: NodeClaim,
     try:
         with span:
             await patch_retry(client, NodeClaim, nc.metadata.name, copy_meta)
+            probes.emit("meta-patch", nc.metadata.name)
             await patch_retry(client, NodeClaim, nc.metadata.name,
                               copy_status, status=True)
+            probes.emit("status-patch", nc.metadata.name)
     except ConflictError:
         pass  # next reconcile sees fresh state
     return wrote["any"]

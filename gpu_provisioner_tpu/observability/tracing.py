@@ -22,14 +22,14 @@ Design constraints, in order:
 from __future__ import annotations
 
 import asyncio
-import contextlib
+import itertools
 import logging
 import time
 import uuid
 from collections import OrderedDict
 from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Optional
 
 # (trace_id, span_id) of the innermost active span in this task, or None.
 # Read by the log-record factory and the event Recorder's trace_ids seam.
@@ -52,11 +52,19 @@ def _mono() -> float:
         return time.monotonic()
 
 
+# One urandom read per process, then a counter: span ids need uniqueness,
+# not unpredictability, and uuid4-per-span is an os.urandom syscall on the
+# reconcile hot path — on a saturated single-core box that alone is a
+# measurable slice of the tracing overhead budget.
+_ID_PREFIX = uuid.uuid4().hex[:8]
+_ID_COUNTER = itertools.count()
+
+
 def _new_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return f"{_ID_PREFIX}{next(_ID_COUNTER):08x}"
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
     """One closed interval inside a trace. ``end`` is stamped at close; a
     span only enters ``Trace.spans`` once closed (open spans live on the
@@ -74,7 +82,7 @@ class Span:
         return max(0.0, self.end - self.start)
 
 
-@dataclass
+@dataclass(slots=True)
 class TraceEvent:
     """Zero-duration annotation (ready, registered, adopted-on-restart)."""
 
@@ -201,6 +209,34 @@ class _OpenSpan:
         self.cv_token = cv_token
 
 
+class _SpanScope:
+    """Hand-rolled context manager over a ``span_begin`` token: the
+    ``@contextmanager`` generator dance costs a generator frame plus three
+    extra calls per span, which the hot reconcile seam pays thousands of
+    times per wave. ``__exit__`` closes unconditionally, same as the old
+    ``finally``."""
+
+    __slots__ = ("_tracer", "_token")
+
+    def __init__(self, tracer: "Tracer", token: Optional[_OpenSpan]):
+        self._tracer = tracer
+        self._token = token
+
+    def __enter__(self) -> Optional[_OpenSpan]:
+        return self._token
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            self._tracer.span_end(self._token)
+        return False
+
+
+# Shared no-op scope for every disabled-tracer span: the disabled path must
+# cost a dict lookup and nothing else — the bench overhead baseline measures
+# against a disabled tracer, so allocations here would poison the baseline.
+_NULL_SCOPE = _SpanScope(None, None)
+
+
 class Tracer:
     """The recording API threaded through controllers/providers/registry.
 
@@ -213,6 +249,7 @@ class Tracer:
                  enabled: bool = True):
         self.store = store if store is not None else TraceStore()
         self.enabled = enabled
+        self._span_names: dict[str, str] = {}
 
     # -- manual pair (PL012: must be closed via try/finally) ---------------
     def span_begin(self, claim: str, name: str, **attrs) -> Optional[_OpenSpan]:
@@ -221,8 +258,9 @@ class Tracer:
         tr = self.store.get_or_create(claim)
         cur = _CURRENT.get()
         parent = cur[1] if cur is not None and cur[0] == tr.trace_id else ""
+        # attrs is this call's own kwargs dict — no defensive copy needed
         sp = Span(span_id=_new_id(), parent_id=parent, name=name,
-                  start=_mono(), attrs=dict(attrs))
+                  start=_mono(), attrs=attrs)
         cv_token = _CURRENT.set((tr.trace_id, sp.span_id))
         return _OpenSpan(tr, sp, cv_token)
 
@@ -236,19 +274,16 @@ class Tracer:
         _CURRENT.reset(token.cv_token)
 
     # -- context-manager form (the one real code uses) ---------------------
-    @contextlib.contextmanager
-    def span(self, claim: str, name: str, **attrs) -> Iterator[Optional[_OpenSpan]]:
-        token = self.span_begin(claim, name, **attrs)
-        try:
-            yield token
-        finally:
-            self.span_end(token)
+    def span(self, claim: str, name: str, **attrs) -> _SpanScope:
+        if not self.enabled:
+            return _NULL_SCOPE
+        # provlint: disable=unclosed-span — the token goes straight into
+        # _SpanScope, whose __exit__ IS the finally-guaranteed span_end
+        return _SpanScope(self, self.span_begin(claim, name, **attrs))
 
-    @contextlib.contextmanager
     def reconcile_span(self, controller: str, claim: str,
                        queue_wait: Optional[float] = None,
-                       wake_source: Optional[str] = None
-                       ) -> Iterator[Optional[_OpenSpan]]:
+                       wake_source: Optional[str] = None) -> _SpanScope:
         """The controller trace seam body: record the queue-wait that ended
         at this dequeue as a completed span, then cover the reconcile.
         ``wake_source`` (what put the item into the ready queue — watch,
@@ -256,23 +291,37 @@ class Tracer:
         attr on the queue-wait span; the critical-path analyzer uses the
         queue-wait's *start* as the moment the preceding idle gap ended, so
         the attr lets it split requeue-idle-gap into woken-early vs
-        timer-fired."""
-        if self.enabled and queue_wait is not None and queue_wait > 0:
-            end = _mono()
-            wattrs = {"wake": wake_source} if wake_source else {}
-            self.record_span(claim, "queue-wait", end - queue_wait, end,
-                             controller=controller, **wattrs)
-        token = self.span_begin(claim, f"reconcile:{controller}",
-                                controller=controller)
-        if (token is not None and wake_source
-                and not (queue_wait is not None and queue_wait > 0)):
+        timer-fired.
+
+        This is the hottest tracer entry point — once per dequeue on every
+        controller — so it inlines ``span_begin`` against a single trace
+        lookup and a cached span name instead of composing the public
+        helpers (which would pay the lookup twice and an f-string per
+        reconcile)."""
+        if not self.enabled:
+            return _NULL_SCOPE
+        tr = self.store.get_or_create(claim)
+        start = _mono()
+        waited = queue_wait is not None and queue_wait > 0
+        if waited:
+            qattrs = {"controller": controller}
+            if wake_source:
+                qattrs["wake"] = wake_source
+            tr.add_span(Span(_new_id(), "", "queue-wait",
+                             start - queue_wait, start, qattrs))
+        name = self._span_names.get(controller)
+        if name is None:
+            name = self._span_names[controller] = f"reconcile:{controller}"
+        cur = _CURRENT.get()
+        parent = cur[1] if cur is not None and cur[0] == tr.trace_id else ""
+        attrs = {"controller": controller}
+        if wake_source and not waited:
             # Zero queue-wait dequeues still carry their wake cause — stamp
             # it on the reconcile span so attribution sees every wake.
-            token.span.attrs["wake"] = wake_source
-        try:
-            yield token
-        finally:
-            self.span_end(token)
+            attrs["wake"] = wake_source
+        sp = Span(_new_id(), parent, name, start, 0.0, attrs)
+        cv_token = _CURRENT.set((tr.trace_id, sp.span_id))
+        return _SpanScope(self, _OpenSpan(tr, sp, cv_token))
 
     # -- cross-task phases with known timestamps ---------------------------
     def record_span(self, claim: str, name: str, start: float, end: float,
@@ -284,14 +333,14 @@ class Tracer:
             return
         tr = self.store.get_or_create(claim)
         tr.add_span(Span(span_id=_new_id(), parent_id=parent_id, name=name,
-                         start=start, end=max(end, start), attrs=dict(attrs)))
+                         start=start, end=max(end, start), attrs=attrs))
 
     def annotate(self, claim: str, name: str, **attrs) -> None:
         """Zero-duration trace event (ready, registered, adopted)."""
         if not self.enabled:
             return
         self.store.get_or_create(claim).add_event(
-            TraceEvent(name=name, at=_mono(), attrs=dict(attrs)))
+            TraceEvent(name=name, at=_mono(), attrs=attrs))
 
     def set_trace_attrs(self, claim: str, **attrs) -> None:
         if not self.enabled:

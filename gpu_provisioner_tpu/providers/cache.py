@@ -35,6 +35,8 @@ import asyncio
 from collections import defaultdict
 from typing import Awaitable, Callable, Optional
 
+from ..runtime import probes
+
 # ---------------------------------------------------------------- registries
 
 # cache name -> {"hits" | "misses" | "coalesced" | "negative_hits" |
@@ -253,11 +255,19 @@ class CountingAPI:
             return attr
         scope = self.scope
 
+        mutating = (name in ("begin_create", "begin_delete")
+                    or (scope == "queuedresources"
+                        and name in ("create", "delete")))
+
         async def counted(*args, **kwargs):
             # resolve at call time so test monkeypatches on the inner fake
             # (e.g. counted list() spies) keep working through the wrapper
             self.calls[name] += 1
             CLOUD_CALLS[f"{scope}.{name}"] += 1
+            if mutating:
+                # one chokepoint covers every cloud mutation the provider
+                # can issue — the schedfuzz fence-before-mutate contract
+                probes.emit("cloud-mutate", f"{scope}.{name}")
             return await getattr(self._inner, name)(*args, **kwargs)
 
         counted.__name__ = name

@@ -47,6 +47,7 @@ from ..errors import (
     REASON_NODES_NOT_READY, REASON_QUEUED_PROVISIONING, REASON_STOCKOUT,
     REASON_STOCKOUT_SUPPRESSED, REASON_UNRESOLVABLE_SHAPE,
 )
+from ..runtime import probes
 from ..runtime.client import Client, patch_retry
 from ..runtime.wakehub import SOURCE_STOCKOUT
 from ..scheduling import Requirements
@@ -656,6 +657,10 @@ class InstanceProvider:
         # then drop on dequeue.
         if self.fence is not None:
             self.fence.check()
+        # emitted even with no fence wired (the check ran and passed) —
+        # schedfuzz's fence-before-mutate contract observes the discipline,
+        # not the token
+        probes.emit("fence-check", None)
 
     async def _adopt_inflight_create(self, name: str) -> None:
         """Resume another incarnation's in-flight create: poll the pool's

@@ -19,6 +19,7 @@ import time
 from typing import Awaitable, Callable, Optional, Protocol
 
 from ..apis.meta import Object
+from . import probes
 from .client import Client
 from .store import WatchEvent
 from .wakehub import SOURCE_INJECT, SOURCE_WATCH
@@ -176,6 +177,12 @@ class Controller:
         w = client.watch(src.cls)
         try:
             async for ev in w:
+                # schedfuzz seam: the moment handler-side code first
+                # observes the event (predicates/map-fns read the object)
+                probes.emit("handler-delivery",
+                            (src.cls.KIND, ev.object.metadata.namespace,
+                             ev.object.metadata.name),
+                            controller=self.name)
                 if src.predicate is not None and not src.predicate(ev.object):
                     continue
                 for req in src.map_fn(ev.object):

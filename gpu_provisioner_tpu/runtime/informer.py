@@ -31,6 +31,7 @@ import logging
 from typing import Optional
 
 from ..apis.meta import Object
+from . import probes
 from .client import Client
 from .store import ADDED, DELETED, WatchEvent
 
@@ -156,6 +157,12 @@ class Informer:
         if old is not None:
             self._unindex(key, old)
         self._cache[key] = obj
+        # schedfuzz cache-apply-before-delivery contract: noted here (not in
+        # _apply) so the initial re-list counts too — a relay subscriber's
+        # replayed ADDEDs are backed by these upserts
+        probes.emit("cache-apply",
+                    (self.cls.KIND, obj.metadata.namespace,
+                     obj.metadata.name))
         for lk_lv in obj.metadata.labels.items():
             self._by_label.setdefault(lk_lv, set()).add(key)
         for name, fn in self._index_fns.items():
@@ -167,6 +174,9 @@ class Informer:
         old = self._cache.pop(key, None)
         if old is not None:
             self._unindex(key, old)
+        probes.emit("cache-apply",
+                    (self.cls.KIND, obj.metadata.namespace,
+                     obj.metadata.name))
 
     def _unindex(self, key, obj: Object) -> None:
         for lk_lv in obj.metadata.labels.items():

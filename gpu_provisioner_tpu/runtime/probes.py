@@ -1,0 +1,57 @@
+"""Ordering probes: the schedfuzz observation seam (analysis/schedfuzz.py).
+
+The interleaving explorer checks happens-before contracts the control plane
+already relies on — cache-apply before handler delivery, meta patch before
+status patch, fence check before cloud mutate, ``WakeHub.stop()`` before any
+late wake. Those contracts live at seams spread across runtime/, providers/
+and controllers/; this module is the one place they report to.
+
+Design constraints, in order:
+
+- **Zero cost disarmed.** ``emit()`` is a module-global ``None`` check; the
+  call sites pay a few attribute loads for the arguments. Nothing here
+  allocates, imports analysis code, or runs by default — the probes are
+  passive the same way the claimtrace tracer is.
+- **No layering leak.** runtime code must not import analysis/ (or anything
+  above itself — provgraph PG001 enforces exactly that); the explorer arms
+  the seam from outside via :func:`arm`.
+- **Synchronous.** A probe fires inline at the seam it observes, so the
+  checker sees events in true program order — the whole point. Probe
+  callbacks must not await, block, or raise (a raising probe is a bug in
+  the harness, not the product; ``emit`` lets it propagate so the fuzz run
+  fails loudly instead of silently dropping evidence).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+# probe(event: str, key, **info) — armed by analysis/schedfuzz, or by tests.
+Probe = Callable[..., None]
+
+_probe: Optional[Probe] = None
+
+
+def arm(probe: Probe) -> Optional[Probe]:
+    """Install ``probe`` as the active sink; returns the previous one so
+    nested harnesses can restore it."""
+    global _probe
+    prev = _probe
+    _probe = probe
+    return prev
+
+
+def disarm(prev: Optional[Probe] = None) -> None:
+    """Remove the active probe (or restore ``prev`` from :func:`arm`)."""
+    global _probe
+    _probe = prev
+
+
+def armed() -> bool:
+    return _probe is not None
+
+
+def emit(event: str, key, **info) -> None:
+    p = _probe
+    if p is not None:
+        p(event, key, **info)

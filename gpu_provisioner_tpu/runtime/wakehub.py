@@ -23,6 +23,8 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Optional
 
+from . import probes
+
 # source -> cumulative wakes that landed an enqueue. Module-level like
 # placement.STOCKOUTS: multiple hubs (multi-shard benches, test Envs in one
 # process) accumulate into one ledger; the exporter tracks deltas.
@@ -77,6 +79,9 @@ class WakeHub:
         if self._stopped:
             return
         self.delivered_total += 1
+        # schedfuzz stop-before-late-wake contract: emitted only for wakes
+        # that actually deliver (a post-stop wake returns above, silently)
+        probes.emit("hub-wake", id(self), name=name, source=source)
         for sink in list(self._sinks):
             await sink(name, source=source)
 
@@ -118,6 +123,7 @@ class WakeHub:
     async def stop(self) -> None:
         """Cancel delayed wakes and reap in-flight deliveries."""
         self._stopped = True
+        probes.emit("hub-stop", id(self))
         for h in self._handles:
             h.cancel()
         self._handles.clear()

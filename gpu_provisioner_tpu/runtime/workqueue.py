@@ -20,6 +20,7 @@ import time
 from collections import deque
 from typing import Any, Hashable, Optional
 
+from . import probes
 from .wakehub import SOURCE_TIMER, note_wake
 
 
@@ -81,6 +82,7 @@ class RateLimitingQueue:
             return
         self._dirty.add(item)
         self._epoch[item] = self._epoch.get(item, 0) + 1
+        probes.emit("wq-enqueue", item, source=source)
         if source is not None:
             self._wake_srcs[item] = source
             note_wake(source)
@@ -191,11 +193,14 @@ class RateLimitingQueue:
             due, _, item, epoch = self._delayed[0]
             if due <= now:
                 heapq.heappop(self._delayed)
+                probes.emit("wq-timer-due", item,
+                            stale=epoch != self._epoch.get(item, 0))
                 if epoch != self._epoch.get(item, 0):
                     # superseded: the item was woken (and reconciled) after
                     # this safety net was armed — firing it now would only
                     # add a spurious reconcile
                     self.stale_timer_drops += 1
+                    probes.emit("wq-stale-drop", item)
                     continue
                 self._add_locked(item, source=SOURCE_TIMER)
             else:

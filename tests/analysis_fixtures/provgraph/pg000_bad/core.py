@@ -1,0 +1,3 @@
+"""TP: malformed waivers — missing reason, unknown rule."""
+A = 1  # provgraph: disable=PG001
+B = 2  # provgraph: disable=PG999 — no such rule

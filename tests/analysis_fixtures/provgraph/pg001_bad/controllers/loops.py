@@ -1,0 +1,1 @@
+"""Import target for the runtime-layer violation."""

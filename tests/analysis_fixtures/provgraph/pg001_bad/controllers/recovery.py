@@ -1,0 +1,2 @@
+"""TP: a controller importing a cloud-specific provider module."""
+from ..providers.gcp import NP_ERROR  # noqa: F401  (PG001: cloud-specific)

@@ -1,0 +1,2 @@
+"""TP: the provider layer importing the control loops above it."""
+from ..controllers import loops  # noqa: F401  (PG001: providers -> controllers)

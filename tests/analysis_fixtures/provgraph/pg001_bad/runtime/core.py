@@ -1,0 +1,2 @@
+"""TP: runtime importing a layer above itself."""
+from ..controllers import loops  # noqa: F401  (PG001: runtime -> controllers)

@@ -1,0 +1,2 @@
+"""TN: controllers import the cloud-NEUTRAL provider seam."""
+from ..providers import instance  # noqa: F401

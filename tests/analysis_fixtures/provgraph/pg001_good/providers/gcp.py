@@ -1,0 +1,1 @@
+"""Cloud-specific module nobody above the seam imports."""

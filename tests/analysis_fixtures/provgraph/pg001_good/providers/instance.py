@@ -1,0 +1,2 @@
+"""TN: providers import runtime (downward edge)."""
+from ..runtime import client  # noqa: F401

@@ -1,0 +1,1 @@
+"""Runtime-internal import target."""

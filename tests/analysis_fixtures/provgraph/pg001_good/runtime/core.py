@@ -1,0 +1,2 @@
+"""TN: runtime imports only its own layer."""
+from . import client  # noqa: F401

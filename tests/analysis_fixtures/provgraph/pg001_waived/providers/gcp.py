@@ -1,0 +1,2 @@
+"""Cloud-specific module (import target)."""
+NP_ERROR = "ERROR"

@@ -1,0 +1,5 @@
+"""TP: a declared wake edge no producer in the package ever fires."""
+
+
+async def reconcile(result):
+    return result(requeue_after=5.0)  # wakes: lro

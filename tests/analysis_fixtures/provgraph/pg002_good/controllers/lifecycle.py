@@ -1,0 +1,9 @@
+"""TN: both declared wake edges have producers elsewhere in the package."""
+
+
+async def reconcile(result):
+    return result(requeue_after=5.0)  # wakes: lro
+
+
+async def registration(result):
+    return result(requeue_after=1.0)  # wakes: node

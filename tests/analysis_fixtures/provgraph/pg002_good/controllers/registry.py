@@ -1,0 +1,10 @@
+"""Producers: a literal-source hub wake and a SOURCE_* watch."""
+from ..runtime.wakehub import SOURCE_NODE
+
+
+async def on_complete(hub, name):
+    await hub.wake(name, "lro")
+
+
+def build(mgr, node_claim_map):
+    mgr.watches(object, map_fn=node_claim_map, wake_source=SOURCE_NODE)

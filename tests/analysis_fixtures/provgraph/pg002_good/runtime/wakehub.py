@@ -1,0 +1,2 @@
+"""Source-constant vocabulary for the fixture."""
+SOURCE_NODE = "node"

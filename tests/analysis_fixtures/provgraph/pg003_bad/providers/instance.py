@@ -1,0 +1,12 @@
+"""TP: a caller reaches a cloud mutation through an unfenced helper.
+
+The helper's own (direct, unfenced) mutation is PL003's jurisdiction; the
+PG003 finding is the CALL in launch(), which holds no fence either."""
+
+
+class Provider:
+    async def _do_create(self, pool):
+        await self.api.begin_create(pool)
+
+    async def launch(self, pool):
+        await self._do_create(pool)

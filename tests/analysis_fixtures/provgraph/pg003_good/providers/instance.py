@@ -1,0 +1,19 @@
+"""TN: both fence disciplines — helper self-fenced, caller-held fence."""
+
+
+class Provider:
+    async def _do_create(self, pool):
+        self._fence_check()
+        await self.api.begin_create(pool)
+
+    async def launch(self, pool):
+        await self._do_create(pool)
+
+
+class Queued:
+    async def _submit(self, qr):
+        await self.queued.create(qr)
+
+    async def ensure(self, qr):
+        self._fence_check()
+        await self._submit(qr)

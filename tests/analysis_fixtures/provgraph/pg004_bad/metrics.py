@@ -1,0 +1,2 @@
+"""TP both directions: registered-but-undocumented + documented ghost."""
+GHOST = "tpu_provisioner_ghost_total"

@@ -1,0 +1,4 @@
+"""TN: every registered family documented, brace shorthands included."""
+HITS = "tpu_provisioner_cache_hits"
+MISSES = "tpu_provisioner_cache_misses"
+WAKES = "tpu_provisioner_wakes_total"

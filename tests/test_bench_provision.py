@@ -3,9 +3,10 @@ tier-1 gate, run via ``make bench`` / ``pytest -m bench``)."""
 
 import pytest
 
+from bench.bench_megawave import check_timer_share
 from bench.bench_provision import (
     bench_constrained_wave, bench_gc_pass, check_budget, check_pr04_budget,
-    make_budget, make_pr04_budget,
+    check_pr09, make_budget, make_pr04_budget,
 )
 
 from .conftest import async_test
@@ -86,3 +87,24 @@ def test_budget_check_flags_regression_and_passes_clean():
     derived = make_budget(good)
     assert derived["gc_pass_kube_lists"] == 3
     assert derived["wave_cloud_calls_per_claim"] == 24.0  # 3× headroom
+
+
+def test_timer_wake_share_gate_flags_fallback_storm():
+    healthy = {"timer_wake_share": 0.001,
+               "wakes_by_source": {"watch": 999, "timer": 1}}
+    assert check_timer_share(healthy, "reference") == []
+    storm = {"timer_wake_share": 0.62,
+             "wakes_by_source": {"timer": 620, "watch": 380}}
+    (violation,) = check_timer_share(storm, "reference")
+    assert "62.0%" in violation and "safety-net" in violation
+
+
+def test_pr09_gate_flags_overhead_and_low_attribution():
+    good = {"attribution": {"attributed_fraction": 0.99},
+            "tracing_overhead_fraction": 0.03}
+    assert check_pr09(good) == []
+    bad = {"attribution": {"attributed_fraction": 0.5},
+           "tracing_overhead_fraction": 0.4}
+    violations = check_pr09(bad)
+    assert any("attribution too low" in v for v in violations)
+    assert any("overhead regressed" in v for v in violations)

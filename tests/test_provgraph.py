@@ -1,0 +1,196 @@
+"""provgraph: interprocedural rule tests over package-shaped fixtures,
+waiver semantics with the ``provgraph`` tag, the CLI, provlint's
+``--changed`` mode, and the enforcement test that keeps the real tree
+clean.
+
+Unlike provlint's single-file snippets, each fixture here is a miniature
+*package* under tests/analysis_fixtures/provgraph/ — the rules are
+relations between modules (import edges, wake producers, call paths, doc
+entries), so the fixture has to be the whole relation, not one side of
+it."""
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from gpu_provisioner_tpu.analysis import provgraph
+from gpu_provisioner_tpu.analysis.provlint import changed_py_files
+from gpu_provisioner_tpu.analysis.provlint import main as provlint_main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "analysis_fixtures" / "provgraph"
+PACKAGE = REPO / "gpu_provisioner_tpu"
+REAL_DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+
+def analyze(pkg: str):
+    root = FIXTURES / pkg
+    doc = root / "OBSERVABILITY.md"
+    return provgraph.analyze(root, doc if doc.is_file() else None)
+
+
+# One (rule, fixture-pair, expected-finding-count) row per rule.
+CASES = [
+    ("PG001", "pg001", 3),   # runtime↑, cloud-specific, providers→controllers
+    ("PG002", "pg002", 1),
+    ("PG003", "pg003", 1),
+    ("PG004", "pg004", 2),   # one per direction
+]
+
+
+@pytest.mark.parametrize("rule_id,stem,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_bad_fixture(rule_id, stem, expected):
+    findings = analyze(f"{stem}_bad")
+    assert [f.rule for f in findings] == [rule_id] * expected, findings
+
+
+@pytest.mark.parametrize("rule_id,stem,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_abstains_on_good_fixture(rule_id, stem, expected):
+    assert analyze(f"{stem}_good") == []
+
+
+def test_pg003_flags_the_call_site_not_the_helper():
+    # The helper's own unfenced begin_create is PL003's jurisdiction; the
+    # graph rule must anchor on the laundering CALL in launch().
+    (finding,) = analyze("pg003_bad")
+    assert finding.path.endswith("providers/instance.py")
+    assert "_do_create" in finding.message
+    assert finding.line == 12
+
+
+def test_pg002_anchors_comment_annotations_on_their_code_line():
+    (finding,) = analyze("pg002_bad")
+    assert finding.line == 5  # the return, not a dangling comment line
+
+
+def test_pg004_reports_both_directions():
+    paths = sorted(f.path for f in analyze("pg004_bad"))
+    assert paths[0].endswith("OBSERVABILITY.md")      # documented ghost
+    assert paths[1].endswith("metrics.py")            # undocumented family
+
+
+def test_waiver_with_reason_silences_the_rule():
+    assert analyze("pg001_waived") == []
+
+
+def test_malformed_waivers_are_pg000():
+    findings = analyze("pg000_bad")
+    assert [f.rule for f in findings] == ["PG000", "PG000"]
+    assert "mandatory" in findings[0].message          # reason missing
+    assert "pg999" in findings[1].message              # unknown rule
+
+
+def test_waiver_tags_do_not_cross_match():
+    # A provgraph waiver must not silence provlint and vice versa: the same
+    # fixture parsed under the provlint tag yields no waivers at all.
+    from gpu_provisioner_tpu.analysis.provlint import parse_waivers
+    lines = (FIXTURES / "pg001_waived" / "controllers" /
+             "recovery.py").read_text().splitlines()
+    known = {"pg001", "layering-violation"}
+    graph = parse_waivers(lines, known, tag="provgraph")
+    lint = parse_waivers(lines, known, tag="provlint")
+    assert graph.exact and not graph.malformed
+    assert not lint.exact and not lint.by_line and not lint.malformed
+
+
+def test_graph_resolves_relative_imports_and_refines_aliases():
+    g = provgraph.build_graph(FIXTURES / "pg001_bad")
+    edges = {(e.src, e.dst) for e in g.import_edges}
+    # `from ..controllers import loops` records the refined module edge
+    assert ("pg001_bad.providers.instance",
+            "pg001_bad.controllers.loops") in edges
+    assert ("pg001_bad.controllers.recovery",
+            "pg001_bad.providers.gcp") in edges
+
+
+def test_whole_tree_is_clean():
+    """The enforcement gate: zero unwaived findings across the real
+    package + the real metrics catalog. Layering debt must be waived in
+    place with a reason (the recovery.py GCP-constant import carries the
+    ROADMAP item-4 pointer), not left silent."""
+    findings = provgraph.analyze(PACKAGE, REAL_DOC)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_list_rules(capsys):
+    assert provgraph.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("PG001", "PG002", "PG003", "PG004"):
+        assert rid in out
+
+
+def test_cli_exit_codes(capsys):
+    bad = str(FIXTURES / "pg001_bad")
+    assert provgraph.main([bad, "--docs", ""]) == 1
+    assert provgraph.main([str(FIXTURES / "pg001_good"),
+                           "--docs", ""]) == 0
+    assert provgraph.main([str(FIXTURES / "missing"), "--docs", ""]) == 2
+    capsys.readouterr()
+
+
+def test_cli_select_and_json(capsys):
+    bad = str(FIXTURES / "pg001_bad")
+    # PG002 alone finds nothing in a layering fixture
+    assert provgraph.main([bad, "--docs", "", "--select", "pg002"]) == 0
+    assert provgraph.main([bad, "--docs", "", "--select", "nope"]) == 2
+    capsys.readouterr()
+    assert provgraph.main([bad, "--docs", "", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in payload} == {"PG001"} and len(payload) == 3
+
+
+# ------------------------------------------------- provlint --changed
+
+def _git(cwd, *argv):
+    subprocess.run(["git", *argv], cwd=cwd, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_py_files_lists_modified_and_untracked(tmp_path,
+                                                       monkeypatch):
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "clean.py").write_text("A = 1\n")
+    (tmp_path / "dirty.py").write_text("B = 1\n")
+    (tmp_path / "notes.md").write_text("prose\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (tmp_path / "dirty.py").write_text("B = 2\n")
+    (tmp_path / "fresh.py").write_text("C = 3\n")
+    (tmp_path / "fresh.md").write_text("prose\n")
+    monkeypatch.chdir(tmp_path)
+    names = sorted(p.name for p in changed_py_files([tmp_path]))
+    assert names == ["dirty.py", "fresh.py"]   # not clean.py, never .md
+
+
+def test_changed_mode_scopes_and_degrades(tmp_path, tmp_path_factory,
+                                          monkeypatch, capsys):
+    _git(tmp_path, "init", "-q")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("A = 1\n")
+    (tmp_path / "outside.py").write_text("B = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (pkg / "mod.py").write_text("A = 2\n")
+    (tmp_path / "outside.py").write_text("B = 2\n")
+    monkeypatch.chdir(tmp_path)
+    # the scope argument narrows the changed set, exactly like a walk
+    assert [p.name for p in changed_py_files([pkg])] == ["mod.py"]
+    assert provlint_main(["--changed", str(pkg)]) == 0
+    capsys.readouterr()
+    # outside a git checkout the mode degrades loudly, not silently
+    nowhere = tmp_path_factory.mktemp("no-repo")
+    monkeypatch.chdir(nowhere)
+    assert provlint_main(["--changed", "."]) == 2
+    assert "--changed needs a git checkout" in capsys.readouterr().err

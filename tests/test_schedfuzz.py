@@ -1,0 +1,197 @@
+"""schedfuzz: loop-shim determinism, happens-before checker semantics over
+synthetic traces, a clean-tree smoke (tier-1's fuzz gate), and the two
+mutation tests that prove the explorer's teeth: each reverts a shipped
+ordering fix via monkeypatch — the tree is never touched — and asserts the
+DEFAULT seed budget catches it and emits a replay file that reproduces."""
+
+import asyncio
+import heapq
+import json
+import time
+
+from gpu_provisioner_tpu.analysis import schedfuzz
+from gpu_provisioner_tpu.analysis.schedfuzz import (
+    DEFAULT_SEEDS, FuzzEvent, check_cache_before_deliver,
+    check_fence_before_mutate, check_meta_before_status,
+    check_stale_timer_requeue, check_stop_before_late_wake, explore,
+    replay, run_scenario,
+)
+from gpu_provisioner_tpu.runtime import workqueue
+from gpu_provisioner_tpu.runtime.informer import CachedListClient
+from gpu_provisioner_tpu.runtime.wakehub import SOURCE_TIMER
+
+
+def ev(*args, task=None, **info):
+    seq, event, key = args
+    return FuzzEvent(seq, event, key, task, info)
+
+
+# ----------------------------------------------- checker unit semantics
+
+def test_cache_before_deliver_counts_per_key_and_skips_uncached():
+    key = ("NodeClaim", "", "ws0")
+    ok = [ev(0, "cache-apply", key),
+          ev(1, "handler-delivery", key, controller="lifecycle")]
+    assert check_cache_before_deliver(ok) == []
+    # delivery outrunning the apply for the SAME key is the violation
+    bad = list(reversed(ok))
+    (v,) = check_cache_before_deliver(bad)
+    assert v.checker == "cache-before-deliver" and v.seq == 1
+    # kinds with no informer (no cache-apply anywhere) are raw watches
+    pod = ("Pod", "", "p0")
+    raw = [ev(0, "handler-delivery", pod, controller="gc"),
+           ev(1, "cache-apply", key)]
+    assert check_cache_before_deliver(raw) == []
+
+
+def test_stale_timer_requeue_allows_the_drop_path():
+    ok = [ev(0, "wq-timer-due", "ws0", stale=True),
+          ev(1, "wq-stale-drop", "ws0"),
+          ev(2, "wq-timer-due", "ws0", stale=False),
+          ev(3, "wq-enqueue", "ws0", source="timer")]
+    assert check_stale_timer_requeue(ok) == []
+    bad = [ev(0, "wq-timer-due", "ws0", stale=True),
+           ev(1, "wq-enqueue", "ws0", source="timer")]
+    (v,) = check_stale_timer_requeue(bad)
+    assert v.checker == "stale-timer-requeue" and v.seq == 1
+
+
+def test_fence_before_mutate_is_task_scoped():
+    ok = [ev(0, "fence-check", None, task="t1"),
+          ev(1, "cloud-mutate", "nodepools.begin_create", task="t1")]
+    assert check_fence_before_mutate(ok) == []
+    # a fence on ANOTHER task does not cover this mutation
+    bad = [ev(0, "fence-check", None, task="t1"),
+           ev(1, "cloud-mutate", "nodepools.begin_create", task="t2")]
+    (v,) = check_fence_before_mutate(bad)
+    assert v.checker == "fence-before-mutate"
+
+
+def test_meta_before_status_counts_per_claim():
+    ok = [ev(0, "meta-patch", "a"), ev(1, "status-patch", "a"),
+          ev(2, "meta-patch", "b"), ev(3, "status-patch", "b")]
+    assert check_meta_before_status(ok) == []
+    bad = [ev(0, "meta-patch", "a"), ev(1, "status-patch", "b")]
+    (v,) = check_meta_before_status(bad)
+    assert v.checker == "meta-before-status" and "'b'" in v.message
+
+
+def test_stop_before_late_wake():
+    ok = [ev(0, "hub-wake", 1, name="ws0", source="lro"),
+          ev(1, "hub-stop", 1),
+          ev(2, "hub-wake", 2, name="ws0", source="lro")]  # other hub
+    assert check_stop_before_late_wake(ok) == []
+    bad = ok + [ev(3, "hub-wake", 1, name="late", source="timer")]
+    (v,) = check_stop_before_late_wake(bad)
+    assert v.checker == "stop-before-late-wake" and "'late'" in v.message
+
+
+# -------------------------------------------------- loop-shim determinism
+
+def _interleaver():
+    async def sample():
+        order = []
+
+        async def worker(i):
+            for _ in range(4):
+                await asyncio.sleep(0)
+                order.append(i)
+
+        await asyncio.gather(*(worker(i) for i in range(8)))
+        return order
+
+    return sample
+
+
+def test_same_seed_reproduces_the_decision_stream():
+    r1 = run_scenario(_interleaver(), seed=7, checkers={})
+    r2 = run_scenario(_interleaver(), seed=7, checkers={})
+    assert r1.decisions and r1.decisions == r2.decisions
+    assert r1.perturbed_total == r2.perturbed_total
+
+
+def test_different_seed_explores_a_different_schedule():
+    r1 = run_scenario(_interleaver(), seed=7, checkers={})
+    r2 = run_scenario(_interleaver(), seed=8, checkers={})
+    assert r1.decisions != r2.decisions
+
+
+def test_scenario_exception_is_a_finding_not_a_crash():
+    async def boom():
+        raise RuntimeError("interleaving-induced")
+
+    res = run_scenario(boom, seed=0, checkers={})
+    assert res.error == "RuntimeError: interleaving-induced"
+    assert not res.ok
+
+
+# ------------------------------------------------------- clean-tree smoke
+
+def test_clean_tree_wave_smoke():
+    """Tier-1's fuzz gate: one seed of the wave scenario under the
+    perturbed loop, all checkers armed — the full `make fuzz` sweep runs
+    under `make chaos` with the real seed budget."""
+    res = run_scenario(schedfuzz.scenario_wave, seed=3)
+    assert res.error is None, res.error
+    assert res.violations == [], res.violations
+    # the run actually observed orderings and actually perturbed them
+    assert len(res.events) > 50 and res.perturbed_total > 10
+
+
+# --------------------------------------------------------- mutation tests
+
+def _raw_store_watch(self, cls):
+    # PR 11 regression, reverted: hand controllers the raw store watch
+    # instead of the informer's post-cache-apply relay.
+    return self.inner.watch(cls)
+
+
+def test_mutation_raw_watch_wiring_is_caught(tmp_path, monkeypatch):
+    monkeypatch.setattr(CachedListClient, "watch", _raw_store_watch)
+    results = explore(schedfuzz.scenario_wave, name="wave",
+                      seeds=range(DEFAULT_SEEDS), replay_dir=tmp_path,
+                      stop_on_first=True)
+    bad = [r for r in results if r.violations]
+    assert bad, "raw-watch wiring escaped the default seed budget"
+    first = bad[0]
+    assert "cache-before-deliver" in {v.checker for v in first.violations}
+    # the replay file is complete and re-finds the same contract breach
+    data = json.loads(first.replay_path.read_text())
+    assert data["format"] == schedfuzz.REPLAY_FORMAT
+    assert data["seed"] == first.seed and data["violations"]
+    res2 = replay(first.replay_path)
+    assert "cache-before-deliver" in {v.checker for v in res2.violations}
+
+
+def _unguarded_drain(self):
+    # PR 11's epoch guard deleted: a stale safety-net timer enqueues a
+    # spurious reconcile instead of being dropped. Probes kept — the
+    # mutation removes the GUARD, not the observability.
+    nxt = None
+    now = time.monotonic()
+    while self._delayed:
+        due, _, item, epoch = self._delayed[0]
+        if due <= now:
+            heapq.heappop(self._delayed)
+            workqueue.probes.emit(
+                "wq-timer-due", item,
+                stale=epoch != self._epoch.get(item, 0))
+            self._add_locked(item, source=SOURCE_TIMER)
+        else:
+            nxt = due - now
+            break
+    return nxt
+
+
+def test_mutation_unguarded_epoch_is_caught(tmp_path, monkeypatch):
+    monkeypatch.setattr(workqueue.RateLimitingQueue,
+                        "_drain_delayed_locked", _unguarded_drain)
+    results = explore(schedfuzz.scenario_churn, name="churn",
+                      seeds=range(DEFAULT_SEEDS), replay_dir=tmp_path,
+                      stop_on_first=True)
+    bad = [r for r in results if r.violations]
+    assert bad, "unguarded epoch drain escaped the default seed budget"
+    assert "stale-timer-requeue" in {v.checker
+                                     for v in bad[0].violations}
+    res2 = replay(bad[0].replay_path)
+    assert "stale-timer-requeue" in {v.checker for v in res2.violations}
