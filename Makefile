@@ -86,9 +86,15 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12 gates
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12/pr14 gates
 	$(PY) -m bench.bench_megawave --gate
 	$(PY) -m bench.bench_provision
+	$(PY) -m bench.bench_fleet --gate
+
+.PHONY: slo
+slo: ## fleetscope suite: SLO engine + flight-recorder tests, then the overhead/memory gate
+	$(PY) -m pytest tests/test_fleet.py -q
+	$(PY) -m bench.bench_fleet --gate
 
 .PHONY: megawave
 megawave: ## Mega-wave smoke: reference gates + a 1k-claim 8-shard wave (full 10k tier: make megawave-full)

@@ -1,0 +1,213 @@
+"""fleetscope benchmark (PR 14): SLO-engine + flight-recorder overhead and
+digest-memory flatness.
+
+Two harnesses, both envtest + FakeCloud, no network:
+
+- **overhead pairs**: the PR 9/PR 12 methodology verbatim — interleaved
+  enabled/disabled PAIRS of a latency-bound 25-claim wave (tracing stays ON
+  in both modes; only the fleet aggregator + flight recorder toggle),
+  medians compared. The fleetscope tax per ready claim is one
+  ``analyze_trace`` + a handful of digest increments, plus a frozenset test
+  per probe emit — gated at ≤ 2% of wave wall.
+- **reference wave**: the 100-claim BENCH_pr09 wave with fleetscope on;
+  its ``/slo`` snapshot (fleet percentiles per placement key, objective
+  burn state) and recorder stats are what ``--write-pr14`` records as
+  ``BENCH_pr14.json``.
+
+The digest-memory check is synthetic and exact: a ``LatencyDigest`` fed
+100 vs 10 000 observations must have the identical bucket structure and
+byte size — O(buckets) streaming state, the property that lets the SLO
+engine outlive the 512-trace ring at mega-wave scale.
+
+Usage: python -m bench.bench_fleet [--gate] [--claims N] [--repeats R]
+                                   [--write-pr14]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+BENCH_PR14_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr14.json"
+
+# Acceptance gates (criteria, not machine-scaled budgets).
+PR14_OVERHEAD_MAX = 0.02
+# Latency-bound wave size, reused from bench_provision's PR 9 overhead
+# pairs: saturation quantizes the wall and measures the box, not the code.
+OVERHEAD_CLAIMS = 25
+
+
+async def bench_wave(n_claims: int, observability: bool = True) -> dict:
+    """One claim wave with tracing always ON; ``observability`` toggles the
+    fleet aggregator + flight recorder (the PR 14 delta under test)."""
+    from gpu_provisioner_tpu.controllers.lifecycle import LifecycleOptions
+    from gpu_provisioner_tpu.controllers.termination import TerminationOptions
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+
+    opts = EnvtestOptions(
+        create_latency=0.05, node_join_delay=0.01, node_ready_delay=0.01,
+        gc_interval=1.0, leak_grace=1.0, node_wait_attempts=600,
+        lifecycle=LifecycleOptions(termination_requeue=0.5,
+                                   registration_requeue=0.5),
+        termination=TerminationOptions(requeue=0.5, instance_requeue=0.5),
+        max_concurrent_reconciles=1024, use_informer=True,
+        tracing=True, trace_buffer=max(2 * n_claims, 64),
+        fleet=observability, flight_recorder=observability,
+        # measurement at saturation: stall gate off, leak gate stays on
+        stall_budget=0.0)
+    async with Env(opts) as env:
+        async def provision(i: int) -> None:
+            await env.client.create(make_nodeclaim(f"t{i:04d}", "tpu-v5e-8",
+                                                   workspace=f"ws{i}"))
+            await env.wait_ready(f"t{i:04d}", timeout=120, poll=0.1)
+
+        wall0 = time.perf_counter()
+        await asyncio.gather(*(provision(i) for i in range(n_claims)))
+        ready_wall = time.perf_counter() - wall0
+
+        slo = env.fleet.snapshot() if env.fleet is not None else None
+        recorder = (env.flight_recorder.stats()
+                    if env.flight_recorder is not None else None)
+    return {
+        "claims": n_claims,
+        "observability": observability,
+        "ready_wall_s": round(ready_wall, 3),
+        "slo": slo,
+        "recorder": recorder,
+    }
+
+
+def digest_memory_check() -> dict:
+    """100 vs 10k observations into a LatencyDigest: identical structure,
+    identical bytes — streaming state must not scale with claim count."""
+    import sys as _sys
+
+    from gpu_provisioner_tpu.observability.fleet import LatencyDigest
+
+    def sized(n: int) -> tuple[dict, LatencyDigest]:
+        d = LatencyDigest()
+        for i in range(n):
+            d.record(0.01 + (i % 97) * 0.013)
+        return {
+            "observations": n,
+            "buckets": len(d.counts),
+            "counts_bytes": _sys.getsizeof(d.counts),
+            "p95_s": round(d.quantile(0.95), 4),
+        }, d
+
+    small, _ = sized(100)
+    big, _ = sized(10_000)
+    return {
+        "small": small,
+        "big": big,
+        "flat": (small["buckets"] == big["buckets"]
+                 and small["counts_bytes"] == big["counts_bytes"]),
+    }
+
+
+async def run_gate(n_claims: int, repeats: int = 3) -> dict:
+    """Reference wave (recorded), then interleaved enabled/disabled pairs
+    for the overhead gate, then the synthetic memory check."""
+    reference = await bench_wave(n_claims, observability=True)
+
+    oh_claims = min(n_claims, OVERHEAD_CLAIMS)
+    # one discarded warm-up pair absorbs allocator/import warm-up
+    await bench_wave(oh_claims, observability=True)
+    await bench_wave(oh_claims, observability=False)
+    enabled_walls: list[float] = []
+    disabled_walls: list[float] = []
+    for _ in range(repeats):
+        e = await bench_wave(oh_claims, observability=True)
+        d = await bench_wave(oh_claims, observability=False)
+        enabled_walls.append(e["ready_wall_s"])
+        disabled_walls.append(d["ready_wall_s"])
+
+    def median(walls: list[float]) -> float:
+        return sorted(walls)[len(walls) // 2]
+
+    overhead = (median(enabled_walls)
+                / max(median(disabled_walls), 1e-9) - 1.0)
+    return {
+        "bench": "fleetscope",
+        "pr": 14,
+        "reference": reference,
+        "overhead": {
+            "claims": oh_claims,
+            "repeats": repeats,
+            "pairing": "interleaved",
+            "statistic": "median",
+            "enabled_walls_s": enabled_walls,
+            "disabled_walls_s": disabled_walls,
+        },
+        "observability_overhead_fraction": round(overhead, 4),
+        "digest_memory": digest_memory_check(),
+        "gates": {"overhead_max": PR14_OVERHEAD_MAX,
+                  "digest_memory_flat": True},
+    }
+
+
+def check_gate(results: dict) -> list[str]:
+    out: list[str] = []
+    overhead = results["observability_overhead_fraction"]
+    if overhead > PR14_OVERHEAD_MAX:
+        out.append(
+            f"fleetscope overhead regressed: {100 * overhead:.1f}% > "
+            f"{100 * PR14_OVERHEAD_MAX:.0f}% wall vs disabled "
+            f"(walls: {results['overhead']})")
+    if not results["digest_memory"]["flat"]:
+        out.append(
+            f"digest memory is not flat across observation counts: "
+            f"{results['digest_memory']} — streaming state must be "
+            "O(buckets), not O(claims)")
+    slo = results["reference"].get("slo")
+    if not slo or slo.get("claims_observed") != results["reference"]["claims"]:
+        out.append(
+            f"reference wave not fully observed by the SLO engine: "
+            f"{None if not slo else slo.get('claims_observed')} of "
+            f"{results['reference']['claims']} claims folded into digests")
+    elif not slo.get("objectives"):
+        out.append("reference snapshot carries no SLO objectives")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--claims", type=int, default=100,
+                    help="reference-wave size (the recorded tier)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="interleaved overhead pairs after the warm-up pair")
+    ap.add_argument("--gate", action="store_true",
+                    help="reference wave + overhead pairs + memory check, "
+                         "gate-enforced (the make bench tier)")
+    ap.add_argument("--write-pr14", action="store_true",
+                    help="record the gate run (SLO percentiles + burn rate "
+                         "+ overhead) as BENCH_pr14.json")
+    args = ap.parse_args(argv)
+
+    results = asyncio.run(run_gate(args.claims, repeats=args.repeats))
+    print(json.dumps(results, indent=2))
+    violations = check_gate(results)
+    if args.write_pr14:
+        BENCH_PR14_FILE.write_text(json.dumps(results, indent=2) + "\n")
+        print(f"wrote {BENCH_PR14_FILE}", file=sys.stderr)
+
+    for v in violations:
+        print(f"FLEETSCOPE GATE: {v}", file=sys.stderr)
+    if violations:
+        return 1
+    slo = results["reference"]["slo"]
+    print(f"fleetscope gates OK (overhead "
+          f"{100 * results['observability_overhead_fraction']:+.1f}%, "
+          f"fleet p95 {slo['fleet']['p95']}s over "
+          f"{slo['claims_observed']} claims, burn "
+          f"{slo['objectives'][0]['burn']})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

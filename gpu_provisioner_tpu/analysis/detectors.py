@@ -55,6 +55,18 @@ class StallDetector:
         self.worst = 0.0
         self.stalls: list[tuple[float, float]] = []   # (loop time, lag)
         self._task: Optional[asyncio.Task] = None
+        # fired (synchronously, with the lag) the moment an over-budget
+        # stall is OBSERVED — the flight recorder's stall trigger, so the
+        # diagnostic bundle snapshots live state instead of waiting for the
+        # teardown-time check() to fail the test after the evidence is gone.
+        self.on_stall = None
+
+    def _notify(self, lag: float) -> None:
+        if self.on_stall is not None:
+            try:
+                self.on_stall(lag)
+            except Exception:  # noqa: BLE001 — a broken hook must not
+                pass           # crash the sentinel loop
 
     def start(self) -> None:
         if self._task is None or self._task.done():
@@ -81,6 +93,7 @@ class StallDetector:
                 self.worst = lag
             if lag > self.budget:
                 self.stalls.append((now, lag))
+                self._notify(lag)
             last = now
 
     def check(self) -> None:

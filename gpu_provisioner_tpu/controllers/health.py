@@ -51,7 +51,7 @@ from ..apis.core import Node
 from ..apis.karpenter import NodeClaim
 from ..apis.serde import now, wall_now
 from ..providers.operations import BackoffLadder
-from ..runtime import NotFoundError, Request, Result
+from ..runtime import NotFoundError, Request, Result, probes
 from ..runtime.client import Client, patch_retry
 from ..runtime.events import Recorder
 from .termination import drain_node, taint_disrupted
@@ -335,6 +335,8 @@ class NodeHealthController:
                 reason=diag.reason)
             self._repairs[req.name] = rep
             REPAIR_STATS["started"] += 1
+            probes.emit("repair-commit", req.name, reason=diag.reason,
+                        group=rep.group)
             if diag.reason == "SpotPreempted":
                 # Feed the placement engine's spot-zone demotion hysteresis:
                 # enough preemptions inside the window and the engine sinks
@@ -379,6 +381,8 @@ class NodeHealthController:
         else:
             REPAIR_STATS["succeeded"] += 1
             record_repair_duration(mono - rep.started)
+            probes.emit("repair-success", req.name, reason=rep.reason,
+                        duration=round(mono - rep.started, 4))
         rep.deleted = True
         return Result()
 
@@ -639,5 +643,12 @@ class NodeHealthController:
             nodes
             and unhealthy >= max(1, self.opts.breaker_min_unhealthy)
             and unhealthy / len(nodes) > self.opts.max_unhealthy_fraction)
+        was = self._breaker_memo[1] if self._breaker_memo else False
         self._breaker_memo = (mono, tripped)
+        if tripped and not was:
+            # Transition INTO tripped only — the memoized steady state would
+            # otherwise re-fire the flight-recorder trigger every TTL.
+            probes.emit("repair-breaker-trip", "cluster",
+                        unhealthy=unhealthy, nodes=len(nodes),
+                        fraction=round(unhealthy / len(nodes), 4))
         return tripped

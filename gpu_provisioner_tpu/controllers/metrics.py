@@ -261,6 +261,90 @@ _stockouts_seen: dict[str, int] = {}
 _fallbacks_seen: dict[tuple[str, str], int] = {}
 _spot_preemptions_seen: dict[str, int] = {}
 
+# ------------------------------------------------------------ fleet SLO
+# The fleetscope aggregator's surface (observability/fleet.py): streaming
+# time-to-ready percentiles per placement key, declared-objective state,
+# and multi-window burn rate. Digests live on the aggregator (that layer
+# never imports prometheus) and are sampled at scrape — the REPAIR_STATS
+# convention. The wake-share gauge rides here too: the bench's "producer
+# fell off the wake hub" safety-net signal, finally live at /metrics.
+
+TIMER_WAKE_SHARE = _get_or_create(
+    Gauge, "tpu_provisioner_timer_wake_share",
+    "Fraction of workqueue wakes sourced from requeue_after timers (vs "
+    "event wakes) since process start — residual polling. Near 0 is "
+    "healthy; a climb toward 1 means producers fell off the wake hub.", [])
+
+SLO_TIME_TO_READY = _get_or_create(
+    Gauge, "tpu_provisioner_slo_time_to_ready_seconds",
+    "Streaming time-to-ready quantiles per {zone, generation, tier, shard} "
+    "placement key (fixed-bucket digest, sampled).",
+    ["zone", "generation", "tier", "shard", "quantile"])
+
+SLO_PHASE_MEAN = _get_or_create(
+    Gauge, "tpu_provisioner_slo_phase_mean_seconds",
+    "Mean per-claim seconds attributed to each critical-path phase across "
+    "all observed claims (sampled).", ["phase"])
+
+SLO_CLAIMS_OBSERVED = _get_or_create(
+    Gauge, "tpu_provisioner_slo_claims_observed",
+    "Ready claims folded into the fleet digests (sampled).", [])
+
+SLO_OBJECTIVE_TARGET = _get_or_create(
+    Gauge, "tpu_provisioner_slo_objective_target_seconds",
+    "Declared time-to-ready target per SLO objective.", ["objective"])
+
+SLO_BURN_RATE = _get_or_create(
+    Gauge, "tpu_provisioner_slo_error_budget_burn_rate",
+    "Error-budget burn rate per objective and window (fast/slow); the "
+    "fast-burn alert fires when BOTH exceed the objective's threshold.",
+    ["objective", "window"])
+
+SLO_VIOLATIONS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_slo_violations_total",
+    "Claims whose time-to-ready exceeded the objective target (delta-fed "
+    "from the aggregator's cumulative count).", ["objective"])
+
+_slo_violations_seen: dict[str, int] = {}
+
+FLIGHT_RECORDER_EVENTS = _get_or_create(
+    Gauge, "tpu_provisioner_flight_recorder_events",
+    "Semantic control-plane events captured by the flight recorder "
+    "(cumulative, sampled).", [])
+
+FLIGHT_RECORDER_BUNDLES = _get_or_create(
+    Gauge, "tpu_provisioner_flight_recorder_bundles",
+    "Diagnostic bundles snapshotted by anomaly triggers (cumulative, "
+    "sampled; repeats of a trigger are deduped, not bundled).", [])
+
+# ---------------------------------------------------------- serving engine
+# models/engine.py stats() bridged into gauges via the fleet ENGINES
+# registry (weak values — a dead engine leaves the scrape). The autoscaler
+# input signal: slot occupancy and queue depth are the demand curve.
+
+ENGINE_SLOTS = _get_or_create(
+    Gauge, "tpu_provisioner_engine_slots",
+    "Decode slots by engine and state (total/active).", ["engine", "state"])
+
+ENGINE_QUEUE_DEPTH = _get_or_create(
+    Gauge, "tpu_provisioner_engine_queue_depth",
+    "Requests queued behind the batcher, by engine.", ["engine"])
+
+ENGINE_REQUESTS = _get_or_create(
+    Gauge, "tpu_provisioner_engine_requests",
+    "Cumulative requests by engine and state (submitted/finished; "
+    "sampled).", ["engine", "state"])
+
+ENGINE_TOKENS_EMITTED = _get_or_create(
+    Gauge, "tpu_provisioner_engine_tokens_emitted",
+    "Cumulative tokens emitted across finished and active requests, by "
+    "engine (sampled).", ["engine"])
+
+ENGINE_PREFIX_CACHE = _get_or_create(
+    Gauge, "tpu_provisioner_engine_prefix_cache",
+    "Prefix-cache effectiveness by engine and stat (entries/hits/misses; "
+    "sampled).", ["engine", "stat"])
+
 _CACHE_GAUGES = (
     ("hits", INSTANCE_CACHE_HITS),
     ("misses", INSTANCE_CACHE_MISSES),
@@ -351,3 +435,60 @@ def update_runtime_gauges(manager) -> None:
             _BREAKER_STATE_VALUE.get(breaker.state, 0.0))
         BREAKER_REJECTED.labels(name).set(breaker.rejected_total)
         _exported_breakers.add(name)
+    # Wake-source share: derived from the same ledger the delta loop above
+    # consumes — timer wakes over all wakes since process start.
+    total_wakes = sum(_wakehub.WAKES.values())
+    if total_wakes:
+        TIMER_WAKE_SHARE.set(_wakehub.WAKES.get("timer", 0) / total_wakes)
+    from ..observability import fleet as _fleet
+    from ..observability import flightrecorder as _flightrecorder
+    claims = 0
+    phase_totals: dict[str, tuple[float, int]] = {}
+    slo_state: dict[str, dict] = {}
+    for agg in list(_fleet.AGGREGATORS):
+        claims += agg.claims_observed
+        for key, digest in list(agg.digests.items()):
+            zone, generation, tier, shard = key
+            for q, qv in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+                SLO_TIME_TO_READY.labels(
+                    zone, generation, tier, shard, q).set(digest.quantile(qv))
+        for phase, digest in list(agg.phase_digests.items()):
+            t, n = phase_totals.get(phase, (0.0, 0))
+            phase_totals[phase] = (t + digest.total, n + digest.count)
+        for trk in agg.slos:
+            st = slo_state.setdefault(
+                trk.objective.name,
+                {"target": trk.objective.target, "bad": 0,
+                 "burn": {"fast": 0.0, "slow": 0.0}})
+            st["bad"] += trk.bad
+            for window, rate in trk.burn_rates().items():
+                st["burn"][window] = max(st["burn"][window], rate)
+    SLO_CLAIMS_OBSERVED.set(claims)
+    for phase, (total, n) in phase_totals.items():
+        SLO_PHASE_MEAN.labels(phase).set(total / n if n else 0.0)
+    for objective, st in slo_state.items():
+        SLO_OBJECTIVE_TARGET.labels(objective).set(st["target"])
+        for window, rate in st["burn"].items():
+            SLO_BURN_RATE.labels(objective, window).set(rate)
+        delta = st["bad"] - _slo_violations_seen.get(objective, 0)
+        if delta > 0:
+            SLO_VIOLATIONS_TOTAL.labels(objective).inc(delta)
+            _slo_violations_seen[objective] = st["bad"]
+    events = bundles = 0
+    for rec in list(_flightrecorder.RECORDERS):
+        events += rec.events_recorded
+        bundles += len(rec.bundles())
+    FLIGHT_RECORDER_EVENTS.set(events)
+    FLIGHT_RECORDER_BUNDLES.set(bundles)
+    for engine, stats in _fleet.engine_stats().items():
+        ENGINE_SLOTS.labels(engine, "total").set(stats["slots"])
+        ENGINE_SLOTS.labels(engine, "active").set(stats["slots_active"])
+        ENGINE_QUEUE_DEPTH.labels(engine).set(stats["queue_depth"])
+        ENGINE_REQUESTS.labels(engine, "submitted").set(
+            stats["requests_submitted"])
+        ENGINE_REQUESTS.labels(engine, "finished").set(
+            stats["requests_finished"])
+        ENGINE_TOKENS_EMITTED.labels(engine).set(stats["tokens_emitted"])
+        for stat in ("entries", "hits", "misses"):
+            ENGINE_PREFIX_CACHE.labels(engine, stat).set(
+                stats[f"prefix_cache_{stat}"])

@@ -53,6 +53,7 @@ from ..providers.instance import (
 )
 from ..apis import labels as wk
 from ..apis.serde import now
+from ..runtime import probes
 from ..runtime.client import Client
 from .gc import _cache_too_stale, GCOptions
 from .metrics import RECOVERY_ADOPTED, RECOVERY_REAPED, RECOVERY_RESUMED
@@ -164,6 +165,8 @@ class RecoveryController:
                 if self.tracer is not None:
                     self.tracer.reanchor(pool.name, uid=nc.metadata.uid,
                                          pool_status=pool.status)
+                probes.emit("recovery-adopt", pool.name, resource="pool",
+                            pool_status=pool.status, resumed=resumed)
                 with self._span(pool.name, "adopt", pool_status=pool.status):
                     if resumed:
                         await self._publish(
@@ -185,6 +188,8 @@ class RecoveryController:
                 if not self._count("qr", qr.name, RECOVERY_RESUMED,
                                    "resuming queued-resource ladder"):
                     continue
+                probes.emit("recovery-adopt", qr.name, resource="qr",
+                            qr_state=qr.state)
                 with self._span(qr.name, "adopt", qr_state=qr.state):
                     await self._publish(
                         nc, "Normal", "CreateResumed",

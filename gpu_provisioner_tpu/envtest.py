@@ -160,6 +160,24 @@ class EnvtestOptions:
     tracing: bool = True
     trace_buffer: int = 512
     trace_max_spans: int = 256
+    # fleetscope (observability/fleet.py + flightrecorder.py), ON by
+    # default like tracing — both are passive (listener + probe sink, no
+    # background tasks), so every envtest run carries fleet SLO digests and
+    # a flight recorder for free and the bench gates their overhead.
+    # - fleet needs tracing (it subscribes to trace annotations); with
+    #   tracing off it silently stays off.
+    # - slo_objectives=None declares one generous default objective
+    #   (p95 ≤ 60s — envtest waves finish in milliseconds, so ordinary
+    #   tests never burn budget; chaos tests pass tight targets +
+    #   second-scale windows to force the fast-burn trigger).
+    # - bundle_dir=None keeps bundles in memory only (served at
+    #   /debugz/bundle); tests point it at tmp_path to prove the disk
+    #   round-trip.
+    fleet: bool = True
+    slo_objectives: object = None
+    flight_recorder: bool = True
+    recorder_capacity: int = 2048
+    bundle_dir: Optional[str] = None
 
 
 def _make_cloud(opts: EnvtestOptions, client: InMemoryClient) -> FakeCloud:
@@ -228,6 +246,29 @@ class Env:
             self.tracer = Tracer(self.trace_store)
             install_log_record_factory()
             trace_ids = current_ids
+        # fleetscope: SLO aggregator (trace listener) + flight recorder
+        # (probes sink, attached in __aenter__ / detached in __aexit__ so a
+        # torn-down Env's recorder never sees another Env's events).
+        self.fleet = None
+        if self.opts.fleet and self.tracer is not None:
+            from .observability.fleet import FleetAggregator, SLOObjective
+            objectives = self.opts.slo_objectives
+            if objectives is None:
+                # envtest timescale: windows in seconds, not minutes; the
+                # 60s target is unreachable by design for healthy waves
+                objectives = (SLOObjective(target=60.0, fast_window=5.0,
+                                           slow_window=60.0),)
+            self.fleet = FleetAggregator(objectives=objectives,
+                                         shard=self.opts.shard_index)
+            self.tracer.add_listener(self.fleet.on_trace_event)
+        self.flight_recorder = None
+        if self.opts.flight_recorder:
+            from .observability.flightrecorder import FlightRecorder
+            self.flight_recorder = FlightRecorder(
+                capacity=self.opts.recorder_capacity,
+                bundle_dir=self.opts.bundle_dir)
+            if self.fleet is not None:
+                self.fleet.on_fast_burn = self.flight_recorder.slo_fast_burn
         # Event-driven wake graph (runtime/wakehub.py): one hub per Env —
         # inject() bypasses the watch map-fns' shard filtering, so a hub
         # shared across shard Envs would enqueue foreign claims into this
@@ -312,9 +353,39 @@ class Env:
         # real operator wires Manager(kube) identically). ChaosClient
         # passes watch() through, so kube chaos still never gates events.
         self.manager = Manager(kube).register(*controllers)
+        if self.flight_recorder is not None:
+            from .observability.flightrecorder import wire_default_sources
+            wire_default_sources(self.flight_recorder,
+                                 manager=self.manager,
+                                 tracker=self.tracker,
+                                 placement=self.provider.placement,
+                                 trace_store=self.trace_store)
         # runtime detectors (analysis/detectors.py), armed in __aenter__
         self.stall = None
         self._threads_before: set = set()
+
+    def _attach_observers(self) -> None:
+        """Hook the flight recorder into the live seams: the probes sink,
+        the transport breaker listeners, and the stall detector. Paired
+        with :meth:`_detach_observers` on every exit path — a torn-down
+        Env's recorder must not keep seeing other Envs' events through the
+        module-global seams."""
+        if self.flight_recorder is None:
+            return
+        from .runtime import probes
+        from .transport import add_breaker_listener
+        probes.add_sink(self.flight_recorder.probe)
+        add_breaker_listener(self.flight_recorder.breaker_opened)
+        if self.stall is not None:
+            self.stall.on_stall = self.flight_recorder.stall
+
+    def _detach_observers(self) -> None:
+        if self.flight_recorder is None:
+            return
+        from .runtime import probes
+        from .transport import remove_breaker_listener
+        probes.remove_sink(self.flight_recorder.probe)
+        remove_breaker_listener(self.flight_recorder.breaker_opened)
 
     async def __aenter__(self) -> "Env":
         import os
@@ -335,6 +406,7 @@ class Env:
             self.stall = StallDetector(budget=budget,
                                        interval=self.opts.stall_interval)
             self.stall.start()
+        self._attach_observers()
         try:
             if self.informers is not None:
                 await self.informers.start()  # sync before first reconcile
@@ -368,6 +440,7 @@ class Env:
                     await closer()
                 except Exception:  # noqa: BLE001 — don't mask the cause
                     pass
+            self._detach_observers()
             if self.stall is not None:
                 await self.stall.stop()
             raise
@@ -375,6 +448,10 @@ class Env:
 
     async def __aexit__(self, *exc) -> None:
         from .analysis import detectors
+        # detach the recorder from the module-global seams first — teardown
+        # chatter (hub-stop and friends) and, above all, OTHER Envs' events
+        # after this one returns must not land in this Env's ring
+        self._detach_observers()
         # Exception-safe teardown: one failing stop must not strand the
         # components after it (the half-torn-down Env would leak its tasks
         # into every later test — the same bug class the startup unwind in

@@ -287,6 +287,16 @@ class ServeEngine:
         self.return_logprobs = return_logprobs
         self.finished_logprobs: dict[int, list[float]] = {}
 
+        # Fleet observability: the weak-value registry bridges stats() into
+        # the tpu_provisioner_engine_* gauges (controllers/metrics.py) —
+        # the input signal the demand autoscaler watches. Lazy import so a
+        # stubbed/absent observability tree never blocks engine bring-up.
+        try:
+            from ..observability.fleet import register_engine
+            register_engine(self)
+        except Exception:  # noqa: BLE001 — registration is best-effort
+            pass
+
     # --- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,

@@ -6,10 +6,18 @@ opened at the existing seams (reconcile, provider state-machine steps, LRO
 resolution, node wait), trace/span IDs injected into log records and Events
 while a span is active, and a critical-path analyzer that decomposes a
 wave's ready-wall into named phases (docs/OBSERVABILITY.md).
+
+fleetscope (PR 14) builds the fleet layer on top: a streaming SLO engine
+folding every ready claim into fixed-bucket percentile digests with
+multi-window burn-rate alerts (``fleet``), and an anomaly-triggered flight
+recorder of semantic control-plane events (``flightrecorder``).
 """
 
 from .critical_path import (analyze_trace, render_attribution,
                             wave_attribution)
+from .fleet import (FleetAggregator, LatencyDigest, SLOObjective,
+                    SLOTracker, engine_stats, register_engine)
+from .flightrecorder import FlightRecorder, wire_default_sources
 from .tracing import (Span, Trace, TraceEvent, Tracer, TraceStore,
                       current_ids, install_log_record_factory,
                       render_waterfall)
@@ -18,4 +26,7 @@ __all__ = [
     "Span", "Trace", "TraceEvent", "Tracer", "TraceStore", "current_ids",
     "install_log_record_factory", "render_waterfall",
     "analyze_trace", "wave_attribution", "render_attribution",
+    "FleetAggregator", "LatencyDigest", "SLOObjective", "SLOTracker",
+    "engine_stats", "register_engine",
+    "FlightRecorder", "wire_default_sources",
 ]

@@ -1,0 +1,347 @@
+"""fleetscope SLO engine: streaming fleet-level latency digests + burn rate.
+
+claimtrace (PR 9) answers "where did THIS claim's time go" from a 512-trace
+ring; at mega-wave scale the ring wraps long before the wave ends, so the
+ring cannot be the source of *fleet* statistics. This module subscribes to
+trace annotations (``Tracer.add_listener``) and folds every claim that goes
+Ready into **fixed-bucket percentile digests** the moment it completes —
+O(buckets) memory per series, so 10k claims cost exactly what 100 do and
+eviction stops mattering.
+
+Three layers, all passive (no background tasks, loop-clock timestamps):
+
+- :class:`LatencyDigest` — a geometric bucket ladder (1 ms … ~21 min,
+  ×1.25). ``record`` is a bisect + increment; ``quantile`` walks the
+  cumulative counts and clamps to the observed min/max.
+- :class:`SLOTracker` — one declared objective ("time-to-ready p{q} ≤
+  target") with the classic multi-window error-budget burn rate: a fast
+  and a slow event-time window must BOTH burn above the threshold before
+  the fast-burn alert fires (a lone fast-window spike is noise; a slow
+  window alone alerts hours late).
+- :class:`FleetAggregator` — the Tracer listener. On ``ready`` it runs the
+  critical-path analyzer over the finished trace, folds wall time into the
+  per-{zone, generation, tier, shard} digest (keys come off the trace attrs
+  the placement walk stamps) and per-phase digests, and feeds every
+  objective. Crossing into fast-burn fires ``on_fast_burn`` — the flight
+  recorder's SLO anomaly trigger.
+
+Counters/digests are sampled by ``controllers/metrics.py`` at scrape time
+into the ``tpu_provisioner_slo_*`` families (this layer never imports
+prometheus — the REPAIR_STATS convention), and ``snapshot()`` is the
+``/slo`` endpoint payload. ``ENGINES`` rides along as the serving-engine
+stats registry (``models/engine.py`` registers, metrics samples
+``tpu_provisioner_engine_*``) — the input signal ROADMAP item 2's
+autoscaler watches, rendezvousing here for the same reason REPAIR_STATS
+rendezvous health and metrics.
+"""
+
+from __future__ import annotations
+
+import weakref
+from bisect import bisect_right
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from .critical_path import analyze_trace
+from .tracing import Trace, _mono
+
+# ---------------------------------------------------------------- registries
+
+# Live aggregators, sampled by controllers/metrics.update_runtime_gauges at
+# scrape time (the ops.TRACKERS idiom: weak so a dead Env's aggregator
+# drops out of the scrape instead of freezing its last gauge values).
+AGGREGATORS: "weakref.WeakSet[FleetAggregator]" = weakref.WeakSet()
+
+# Serving engines by name → weakly-held engine objects exposing ``stats()``
+# (models/engine.py registers itself at construction). Weak values: an
+# engine garbage-collected with its test/benchmark disappears from the
+# scrape rather than pinning a jax params tree alive.
+ENGINES: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+
+
+def register_engine(engine, name: Optional[str] = None) -> str:
+    """Register a serving engine's ``stats()`` surface under ``name``
+    (default: ``engine-N`` in registration order). Re-using a name replaces
+    the previous engine — restart semantics, not an error."""
+    if name is None:
+        name = f"engine-{len(ENGINES)}"
+    ENGINES[name] = engine
+    return name
+
+
+def engine_stats() -> dict[str, dict]:
+    """Snapshot every live engine's counters (best-effort; a half-torn-down
+    engine is skipped rather than failing the scrape)."""
+    out: dict[str, dict] = {}
+    for name, eng in list(ENGINES.items()):
+        try:
+            out[name] = eng.stats()
+        except Exception:  # noqa: BLE001 — observability only
+            continue
+    return out
+
+
+# ------------------------------------------------------------------ digests
+
+# Geometric ladder: 1 ms × 1.25^i for 64 buckets ≈ 1 ms … 21 min, ~11%
+# relative quantile error. Shared module-wide so a digest is one small list
+# of ints — the "memory flat from 100 to 10k claims" property the bench
+# gates (BENCH_pr14.json).
+BUCKET_BOUNDS: tuple[float, ...] = tuple(
+    0.001 * 1.25 ** i for i in range(64))
+
+
+class LatencyDigest:
+    """Fixed-bucket streaming percentile sketch. O(len(BUCKET_BOUNDS))
+    memory regardless of how many observations were recorded."""
+
+    __slots__ = ("counts", "count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(BUCKET_BOUNDS) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = 0.0
+        self.max = 0.0
+
+    def record(self, value: float) -> None:
+        v = max(0.0, float(value))
+        self.counts[bisect_right(BUCKET_BOUNDS, v)] += 1
+        if self.count == 0 or v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        self.count += 1
+        self.total += v
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile's bucket upper bound, clamped to the observed
+        [min, max] so a one-sample digest reports the sample itself."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c:
+                hi = (BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS)
+                      else self.max)
+                return min(max(hi, self.min), self.max)
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": round(self.mean, 6),
+            "p50": round(self.quantile(0.50), 6),
+            "p90": round(self.quantile(0.90), 6),
+            "p95": round(self.quantile(0.95), 6),
+            "p99": round(self.quantile(0.99), 6),
+            "max": round(self.max, 6),
+        }
+
+
+# --------------------------------------------------------------- objectives
+
+@dataclass(frozen=True)
+class SLOObjective:
+    """A declared objective: at least ``percentile`` of claims must reach
+    Ready within ``target`` seconds. The error budget is the complement
+    (p95 ≤ target ⇒ 5% of claims may miss). Window lengths default to the
+    production multi-window pair (5 m fast / 1 h slow); envtest passes
+    second-scale windows — same math, compressed clock."""
+
+    name: str = "time-to-ready"
+    target: float = 600.0
+    percentile: float = 0.95
+    fast_window: float = 300.0
+    slow_window: float = 3600.0
+    # Both windows must burn ≥ threshold to alert. 14.4 is the canonical
+    # "2% of a 30-day budget in one hour" page threshold.
+    burn_threshold: float = 14.4
+    # Below this many samples in the fast window the alert holds its fire —
+    # one bad claim into an empty window is burn ∞, not an incident.
+    min_samples: int = 10
+
+    @property
+    def error_budget(self) -> float:
+        return max(1.0 - self.percentile, 1e-9)
+
+
+class BurnWindow:
+    """Event-time good/bad counts over a sliding window, bucketed into
+    ``slots`` fixed slots — O(slots) memory, loop-clock, no tasks."""
+
+    __slots__ = ("window", "slots", "_gran", "_clock", "_ring")
+
+    def __init__(self, window: float, slots: int = 15,
+                 clock: Callable[[], float] = _mono):
+        self.window = window
+        self.slots = slots
+        self._gran = max(window / slots, 1e-6)
+        self._clock = clock
+        self._ring: list[list] = []   # [slot_index, good, bad], ascending
+
+    def _expire(self, now_idx: int) -> None:
+        live = now_idx - self.slots
+        while self._ring and self._ring[0][0] <= live:
+            self._ring.pop(0)
+
+    def note(self, ok: bool) -> None:
+        idx = int(self._clock() / self._gran)
+        if not self._ring or self._ring[-1][0] != idx:
+            self._ring.append([idx, 0, 0])
+        self._ring[-1][1 if ok else 2] += 1
+        self._expire(idx)
+
+    def counts(self) -> tuple[int, int]:
+        self._expire(int(self._clock() / self._gran))
+        good = sum(s[1] for s in self._ring)
+        bad = sum(s[2] for s in self._ring)
+        return good, bad
+
+    def bad_fraction(self) -> float:
+        good, bad = self.counts()
+        total = good + bad
+        return bad / total if total else 0.0
+
+
+class SLOTracker:
+    """One objective's live state: cumulative good/bad plus the fast/slow
+    burn windows."""
+
+    def __init__(self, objective: SLOObjective,
+                 clock: Callable[[], float] = _mono):
+        self.objective = objective
+        self.good = 0
+        self.bad = 0
+        self.fast = BurnWindow(objective.fast_window, clock=clock)
+        self.slow = BurnWindow(objective.slow_window, clock=clock)
+
+    def note(self, time_to_ready: float) -> None:
+        ok = time_to_ready <= self.objective.target
+        if ok:
+            self.good += 1
+        else:
+            self.bad += 1
+        self.fast.note(ok)
+        self.slow.note(ok)
+
+    def burn_rates(self) -> dict[str, float]:
+        budget = self.objective.error_budget
+        return {"fast": self.fast.bad_fraction() / budget,
+                "slow": self.slow.bad_fraction() / budget}
+
+    def fast_burning(self) -> bool:
+        """The multi-window alert condition: both windows over threshold,
+        with enough fast-window evidence to mean it."""
+        fg, fb = self.fast.counts()
+        if fg + fb < self.objective.min_samples:
+            return False
+        burn = self.burn_rates()
+        t = self.objective.burn_threshold
+        return burn["fast"] >= t and burn["slow"] >= t
+
+    def to_dict(self) -> dict:
+        o = self.objective
+        return {
+            "name": o.name,
+            "target_s": o.target,
+            "percentile": o.percentile,
+            "good": self.good,
+            "violations": self.bad,
+            "burn": {k: round(v, 4) for k, v in self.burn_rates().items()},
+            "fast_burning": self.fast_burning(),
+        }
+
+
+# --------------------------------------------------------------- aggregator
+
+# Trace attrs the placement walk stamps on the chosen candidate; absent
+# (single-zone legacy world, direct provider tests) they read "none".
+_KEY_ATTRS = ("zone", "generation", "tier")
+
+
+class FleetAggregator:
+    """The Tracer listener that turns per-claim traces into fleet SLO state.
+
+    Passive and synchronous: ``on_trace_event`` runs inside the annotate
+    call that marked the claim Ready — one ``analyze_trace`` (O(spans log
+    spans) over an already-bounded trace) plus a handful of digest
+    increments per claim, which the bench gates at ≤ 2% of wave wall."""
+
+    def __init__(self, objectives: Optional[Iterable[SLOObjective]] = None,
+                 shard: int = 0, clock: Callable[[], float] = _mono):
+        self.shard = str(shard)
+        self.fleet = LatencyDigest()
+        self.digests: dict[tuple[str, str, str, str], LatencyDigest] = {}
+        self.phase_digests: dict[str, LatencyDigest] = {}
+        self.slos = [SLOTracker(o, clock=clock)
+                     for o in (objectives
+                               if objectives is not None
+                               else (SLOObjective(),))]
+        self.claims_observed = 0
+        self.unattributed = 0     # ready traces analyze_trace couldn't place
+        # fired on the transition INTO fast-burn per objective — the flight
+        # recorder's slo-fast-burn trigger (re-arming when burn clears).
+        self.on_fast_burn: Optional[Callable[[SLOTracker], None]] = None
+        self._burning: set[str] = set()
+        AGGREGATORS.add(self)
+
+    # Tracer.add_listener signature
+    def on_trace_event(self, trace: Trace, name: str) -> None:
+        if name == "ready":
+            self.observe(trace)
+
+    def observe(self, trace: Trace) -> None:
+        res = analyze_trace(trace)
+        if res is None:
+            self.unattributed += 1
+            return
+        wall = res["wall"]
+        attrs = trace.attrs
+        key = tuple(str(attrs.get(a, "none")) for a in _KEY_ATTRS) + (
+            self.shard,)
+        d = self.digests.get(key)
+        if d is None:
+            d = self.digests[key] = LatencyDigest()
+        d.record(wall)
+        self.fleet.record(wall)
+        for phase, secs in res["phases"].items():
+            pd = self.phase_digests.get(phase)
+            if pd is None:
+                pd = self.phase_digests[phase] = LatencyDigest()
+            pd.record(secs)
+        self.claims_observed += 1
+        for t in self.slos:
+            t.note(wall)
+            name = t.objective.name
+            if t.fast_burning():
+                if name not in self._burning:
+                    self._burning.add(name)
+                    if self.on_fast_burn is not None:
+                        self.on_fast_burn(t)
+            else:
+                self._burning.discard(name)
+
+    def snapshot(self) -> dict:
+        """The ``/slo`` endpoint payload."""
+        return {
+            "shard": self.shard,
+            "claims_observed": self.claims_observed,
+            "unattributed": self.unattributed,
+            "fleet": self.fleet.summary(),
+            "keys": [
+                dict(zip(("zone", "generation", "tier", "shard"), key),
+                     **digest.summary())
+                for key, digest in sorted(self.digests.items())
+            ],
+            "phases": {phase: d.summary()
+                       for phase, d in sorted(self.phase_digests.items())},
+            "objectives": [t.to_dict() for t in self.slos],
+        }

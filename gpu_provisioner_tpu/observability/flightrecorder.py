@@ -1,0 +1,275 @@
+"""Flight recorder: bounded ring of semantic control-plane events plus
+anomaly-triggered diagnostic bundles.
+
+The claimtrace ring answers "where did this claim's time go"; metrics
+answer "how much of everything happened". Neither answers the incident
+question — *what was the control plane doing right before it went
+sideways* — once the 512-trace ring has wrapped. The recorder keeps the
+last N **semantic** events (wakes, fence drops, breaker trips, placement
+verdicts, repair decisions — not the hot per-reconcile chatter) in an
+O(capacity) ring, and when an anomaly trigger fires (SLO fast-burn,
+circuit-breaker or mass-repair-breaker trip, stall detector, recovery
+adoption) it freezes a **bundle**: the ring, per-shard queue depths,
+inflight cloud ops, recent trace summaries, placement memos. Bundles are
+written to disk (when a directory is configured) and served at
+``/debugz/bundle`` — the black box you pull after the crash.
+
+The recorder taps the same ``runtime/probes`` seam schedfuzz arms
+(PR 12), attached as a persistent *sink* so a fuzz probe and a recorder
+coexist. Attachment is from outside (envtest / the operator main), never
+by runtime importing this module — PG001 layering. Disabled, the probe
+fast path stays a single module-global ``None`` check; tests pin that
+structurally. ``probe()`` is synchronous and must stay cheap: membership
+test, deque append, and — only on the rare trigger events — a bundle
+snapshot.
+
+Exactly-one-bundle-per-distinct-trigger: a zonal stockout trips the same
+breaker on every reconcile tick for minutes; writing a bundle per tick
+would bury the interesting first one and thrash the disk. Triggers dedupe
+on (kind, key) — repeats increment ``triggers_suppressed`` and are
+otherwise free.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import weakref
+from collections import deque
+from pathlib import Path
+from typing import Callable, Optional
+
+from .tracing import _mono
+
+log = logging.getLogger("flightrecorder")
+
+# Live recorders, sampled by controllers/metrics.update_runtime_gauges at
+# scrape (the ops.TRACKERS idiom — weak, so a torn-down Env's recorder
+# drops out of the scrape).
+RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+
+# Probe events worth remembering. Deliberately NOT the hot path —
+# wq-enqueue, cache-apply, handler-delivery, meta-patch, status-patch,
+# fence-check, cloud-mutate and wq-timer-due fire per reconcile and would
+# reduce the ring to the last few milliseconds; the semantic events below
+# fire on *decisions*, so a 2048-slot ring spans minutes of real trouble.
+RECORDED_EVENTS = frozenset({
+    "hub-wake",            # wakehub delivered a wake (source-labelled)
+    "hub-stop",            # wakehub shut down
+    "wq-stale-drop",       # workqueue dropped a stale/superseded item
+    "fence-drop",          # deletion fence rejected a late mutation
+    "breaker-open",        # transport circuit breaker opened
+    "repair-breaker-trip",  # mass-repair breaker crossed its fraction
+    "repair-commit",       # health controller committed a repair
+    "repair-success",      # a repaired node came back
+    "recovery-adopt",      # restart recovery adopted pre-existing capacity
+    "placement-verdict",   # candidate walk decided (chosen/stockout/...)
+})
+
+# Probe event → trigger kind. These snapshot a bundle *in addition to*
+# landing in the ring. SLO fast-burn and stall arrive via trigger()
+# directly (they are not probe events).
+TRIGGER_EVENTS = {
+    "breaker-open": "breaker-trip",
+    "repair-breaker-trip": "repair-breaker-trip",
+    "recovery-adopt": "recovery-adoption",
+}
+
+
+def _jsonable(v):
+    """Best-effort coercion for probe info values — bundles must always
+    serialize, whatever a probe site passed."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    return repr(v)
+
+
+class FlightRecorder:
+    """Bounded semantic-event ring + trigger-deduped bundle snapshots.
+
+    Passive: no tasks, no locks (single event loop), loop-clock stamps.
+    ``sources`` are zero-arg callables contributing one section each to a
+    bundle (queue depths, inflight ops, trace summaries, placement memos);
+    a failing source contributes its error string instead of failing the
+    snapshot — the recorder must never make an incident worse.
+    """
+
+    def __init__(self, capacity: int = 2048,
+                 bundle_dir: Optional[str] = None,
+                 clock: Callable[[], float] = _mono):
+        self.capacity = capacity
+        self.bundle_dir = Path(bundle_dir) if bundle_dir else None
+        self._clock = clock
+        self._ring: deque = deque(maxlen=capacity)
+        self._sources: dict[str, Callable[[], object]] = {}
+        self._bundles: dict[str, dict] = {}   # tkey → bundle, insert-ordered
+        self._seq = 0
+        self.events_recorded = 0
+        self.bundles_written = 0
+        self.triggers_suppressed = 0
+        RECORDERS.add(self)
+
+    # ------------------------------------------------------------- wiring
+
+    def add_source(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a bundle section provider (idempotent by name)."""
+        self._sources[name] = fn
+
+    # The probes.add_sink signature. Hot-ish path: one frozenset test for
+    # everything emit() fans out, ring append only for recorded events.
+    def probe(self, event: str, key, **info) -> None:
+        if event not in RECORDED_EVENTS:
+            return
+        self._seq += 1
+        self.events_recorded += 1
+        self._ring.append({
+            "seq": self._seq,
+            "at": round(self._clock(), 6),
+            "event": event,
+            "key": str(key),
+            **({"info": _jsonable(info)} if info else {}),
+        })
+        kind = TRIGGER_EVENTS.get(event)
+        if kind is not None:
+            # A probe site's info kwargs must never shadow trigger()'s own
+            # parameters — a recorder quirk can't be allowed to raise back
+            # into control-plane code through the emit fan-out.
+            safe = {k: v for k, v in info.items()
+                    if k not in ("kind", "key")}
+            self.trigger(kind, key=str(key), **safe)
+
+    # Breaker-listener signature (transport.add_breaker_listener) — the
+    # transport layer is below runtime and has no probes import, so it
+    # calls listeners directly and the recorder adapts here.
+    def breaker_opened(self, name: str, **info) -> None:
+        self.probe("breaker-open", name, **info)
+
+    def slo_fast_burn(self, tracker) -> None:
+        """FleetAggregator.on_fast_burn adapter."""
+        o = tracker.objective
+        self.trigger("slo-fast-burn", key=o.name,
+                     target_s=o.target, burn=tracker.burn_rates())
+
+    def stall(self, lag: float) -> None:
+        """StallDetector.on_stall adapter."""
+        self.trigger("stall", key="event-loop", lag_s=round(lag, 4))
+
+    # ----------------------------------------------------------- triggers
+
+    def trigger(self, kind: str, key: str = "", **info) -> Optional[dict]:
+        """Snapshot a bundle for (kind, key) — once. Repeats are counted
+        and suppressed so a flapping breaker can't thrash the disk."""
+        tkey = f"{kind}:{key}" if key else kind
+        if tkey in self._bundles:
+            self.triggers_suppressed += 1
+            return None
+        bundle = self._snapshot(kind, tkey, _jsonable(info))
+        self._bundles[tkey] = bundle
+        self._write(bundle)
+        # Leave a marker in the ring so later bundles show earlier ones.
+        self._seq += 1
+        self._ring.append({"seq": self._seq,
+                           "at": round(self._clock(), 6),
+                           "event": "bundle-snapshot", "key": tkey})
+        return bundle
+
+    def _snapshot(self, kind: str, tkey: str, info: dict) -> dict:
+        sources = {}
+        for name, fn in self._sources.items():
+            try:
+                sources[name] = _jsonable(fn())
+            except Exception as exc:  # noqa: BLE001 — never worsen incident
+                sources[name] = {"error": repr(exc)}
+        return {
+            "trigger": {"kind": kind, "key": tkey, "info": info,
+                        "at": round(self._clock(), 6),
+                        "wall_time": time.time()},
+            "seq": self._seq,
+            "events": list(self._ring),
+            "sources": sources,
+        }
+
+    def _write(self, bundle: dict) -> None:
+        if self.bundle_dir is None:
+            self.bundles_written += 1
+            return
+        try:
+            self.bundle_dir.mkdir(parents=True, exist_ok=True)
+            safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                           for c in bundle["trigger"]["key"])
+            path = self.bundle_dir / f"bundle-{self._seq:08d}-{safe}.json"
+            path.write_text(json.dumps(bundle, indent=1, sort_keys=True))
+            self.bundles_written += 1
+        except OSError:
+            log.warning("flight recorder could not write bundle",
+                        exc_info=True)
+
+    # ------------------------------------------------------------ reading
+
+    def events(self) -> list[dict]:
+        return list(self._ring)
+
+    def bundles(self) -> list[dict]:
+        """All bundles this run, oldest first (the /debugz/bundle list)."""
+        return list(self._bundles.values())
+
+    def bundle(self, tkey: Optional[str] = None) -> Optional[dict]:
+        """One bundle: by trigger key, or the most recent."""
+        if tkey is not None:
+            return self._bundles.get(tkey)
+        if not self._bundles:
+            return None
+        return next(reversed(self._bundles.values()))
+
+    def stats(self) -> dict:
+        return {
+            "events_recorded": self.events_recorded,
+            "ring_len": len(self._ring),
+            "capacity": self.capacity,
+            "bundles": len(self._bundles),
+            "bundles_written": self.bundles_written,
+            "triggers_suppressed": self.triggers_suppressed,
+        }
+
+
+def wire_default_sources(recorder: FlightRecorder, *, manager=None,
+                         tracker=None, placement=None,
+                         trace_store=None) -> None:
+    """Attach the standard bundle sections for whatever subsystems exist.
+
+    Everything is held weakly-by-closure on the objects the caller already
+    owns; sources are snapshots, so a bundle taken mid-teardown degrades to
+    error strings instead of raising.
+    """
+    if manager is not None:
+        def queue_depths() -> dict:
+            out = {}
+            for c in getattr(manager, "controllers", []):
+                q = getattr(c, "queue", None)
+                if q is None:
+                    continue
+                out[c.name] = {"shard": getattr(c, "shard_index", 0),
+                               "depth": q.depth(),
+                               "delayed": q.delayed(),
+                               "retrying": q.retrying()}
+            return out
+        recorder.add_source("queue_depths", queue_depths)
+
+    if tracker is not None:
+        recorder.add_source(
+            "inflight_ops",
+            lambda: {"inflight": tracker.inflight(),
+                     "completed_total": tracker.completed_total})
+
+    if placement is not None:
+        recorder.add_source("placement_memos", placement.snapshot)
+
+    if trace_store is not None:
+        recorder.add_source(
+            "recent_traces",
+            lambda: [t.summary() for t in trace_store.recent(20)])

@@ -119,6 +119,13 @@ class Trace:
         starts = [s.start for s in self.spans] + [e.at for e in self.events]
         return min(starts) if starts else None
 
+    def last_at(self) -> float:
+        """Loop-clock timestamp of the trace's most recent activity — the
+        ``/traces?since=`` cursor (clients echo the value back; monotonic
+        values are opaque but orderable)."""
+        ends = [s.end for s in self.spans] + [e.at for e in self.events]
+        return max(ends) if ends else 0.0
+
     def to_dict(self) -> dict:
         """JSON shape served by ``/traces/{claim}`` — offsets are relative
         to the trace's first timestamp (monotonic values mean nothing to a
@@ -150,6 +157,7 @@ class Trace:
             "claim": self.claim, "trace_id": self.trace_id,
             "spans": len(self.spans), "events": len(self.events),
             "span_window": round(max(ends) - t0, 6) if t0 is not None else 0.0,
+            "last_at": round(max(ends), 6) if ends else 0.0,
             "attrs": dict(self.attrs),
         }
 
@@ -250,6 +258,21 @@ class Tracer:
         self.store = store if store is not None else TraceStore()
         self.enabled = enabled
         self._span_names: dict[str, str] = {}
+        # Annotation listeners (the fleet SLO aggregator's subscription
+        # seam): fn(trace, event_name), called synchronously after the
+        # event is recorded. Tuple, not list — ``annotate`` is on the
+        # reconcile path and the empty-tuple check is one truthiness test.
+        self._listeners: tuple = ()
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(trace, event_name)`` to every trace annotation.
+        Listener exceptions are logged and swallowed — a broken aggregator
+        must not fail the reconcile that happened to go Ready."""
+        if fn not in self._listeners:
+            self._listeners = self._listeners + (fn,)
+
+    def remove_listener(self, fn) -> None:
+        self._listeners = tuple(f for f in self._listeners if f is not fn)
 
     # -- manual pair (PL012: must be closed via try/finally) ---------------
     def span_begin(self, claim: str, name: str, **attrs) -> Optional[_OpenSpan]:
@@ -339,8 +362,16 @@ class Tracer:
         """Zero-duration trace event (ready, registered, adopted)."""
         if not self.enabled:
             return
-        self.store.get_or_create(claim).add_event(
-            TraceEvent(name=name, at=_mono(), attrs=attrs))
+        tr = self.store.get_or_create(claim)
+        tr.add_event(TraceEvent(name=name, at=_mono(), attrs=attrs))
+        if self._listeners:
+            for fn in self._listeners:
+                try:
+                    fn(tr, name)
+                except Exception:  # noqa: BLE001 — observability only
+                    logging.getLogger("claimtrace").warning(
+                        "trace listener failed on %s/%s", claim, name,
+                        exc_info=True)
 
     def set_trace_attrs(self, claim: str, **attrs) -> None:
         if not self.enabled:

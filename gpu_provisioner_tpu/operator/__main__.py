@@ -53,12 +53,23 @@ async def run_simulate(opts) -> int:
     env_opts.shard_index = opts.shard_index
     env_opts.tracing = opts.tracing_enabled
     env_opts.trace_buffer = opts.trace_buffer
+    env_opts.fleet = opts.fleet_enabled
+    if opts.fleet_enabled:
+        from ..observability import SLOObjective
+        env_opts.slo_objectives = (SLOObjective(
+            target=opts.slo_target_seconds,
+            burn_threshold=opts.slo_fast_burn_threshold),)
+    env_opts.flight_recorder = opts.flight_recorder_enabled
+    env_opts.recorder_capacity = opts.recorder_capacity
+    env_opts.bundle_dir = opts.bundle_dir or None
 
     async with Env(env_opts) as env:
         runners = await start_servers(env.manager, opts.metrics_port,
                                       opts.health_probe_port,
                                       opts.enable_profiling,
-                                      trace_store=env.trace_store)
+                                      trace_store=env.trace_store,
+                                      fleet=env.fleet,
+                                      recorder=env.flight_recorder)
         log.info("simulated operator up",
                  extra={"metrics_port": opts.metrics_port,
                         "health_port": opts.health_probe_port})
@@ -178,6 +189,29 @@ async def run_real(opts) -> int:
         tracer = Tracer(trace_store)
         trace_ids = current_ids
 
+    # fleetscope: SLO aggregator (trace listener, needs tracing) + flight
+    # recorder (probes sink). Both passive; served at /slo and
+    # /debugz/bundle on the metrics port.
+    fleet = recorder = None
+    if opts.fleet_enabled and tracer is not None:
+        from ..observability import FleetAggregator, SLOObjective
+        fleet = FleetAggregator(
+            objectives=(SLOObjective(
+                target=opts.slo_target_seconds,
+                burn_threshold=opts.slo_fast_burn_threshold),),
+            shard=opts.shard_index)
+        tracer.add_listener(fleet.on_trace_event)
+    if opts.flight_recorder_enabled:
+        from ..observability import FlightRecorder
+        from ..runtime import probes
+        from ..transport import add_breaker_listener
+        recorder = FlightRecorder(capacity=opts.recorder_capacity,
+                                  bundle_dir=opts.bundle_dir or None)
+        probes.add_sink(recorder.probe)
+        add_breaker_listener(recorder.breaker_opened)
+        if fleet is not None:
+            fleet.on_fast_burn = recorder.slo_fast_burn
+
     from ..runtime.wakehub import WakeHub
 
     # Event-driven wake graph: every requeue-producing path (tracker LRO
@@ -244,6 +278,14 @@ async def run_real(opts) -> int:
         tracker=tracker, tracer=tracer,
         wakehub=wakehub, status_batcher=status_batcher)
     manager = Manager(kube).register(*controllers)
+    if recorder is not None:
+        from ..observability import wire_default_sources
+        # diagnostic-bundle sources: live state snapshotted when an anomaly
+        # trigger fires (queue depths, inflight LROs, placement memos,
+        # recent traces)
+        wire_default_sources(recorder, manager=manager, tracker=tracker,
+                             placement=provider.placement,
+                             trace_store=trace_store)
 
     stop = asyncio.Event()
     elector = None
@@ -281,7 +323,8 @@ async def run_real(opts) -> int:
     runners = await start_servers(manager, opts.metrics_port,
                                   opts.health_probe_port,
                                   opts.enable_profiling,
-                                  trace_store=trace_store)
+                                  trace_store=trace_store,
+                                  fleet=fleet, recorder=recorder)
     log.info("operator up", extra={"project": cfg.project_id,
                                    "location": cfg.location,
                                    "cluster": cfg.cluster_name})
@@ -294,6 +337,13 @@ async def run_real(opts) -> int:
     try:
         await stop.wait()
     finally:
+        if recorder is not None:
+            # detach first: shutdown chatter (hub stops, fence drops) must
+            # not land in the ring after the servers stop serving it
+            from ..runtime import probes
+            from ..transport import remove_breaker_listener
+            probes.remove_sink(recorder.probe)
+            remove_breaker_listener(recorder.breaker_opened)
         await manager.stop()
         # final drain flushes the last batch before the store goes away;
         # the hub stops after the tracker, whose subscribers call its wake

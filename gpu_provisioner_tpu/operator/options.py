@@ -85,6 +85,18 @@ class Options:
     # (bounded ring buffer, no background tasks).
     tracing_enabled: bool = True
     trace_buffer: int = 512
+    # fleetscope (observability/fleet.py + flightrecorder.py): fleet SLO
+    # digests served at /slo, anomaly bundles at /debugz/bundle. Default on
+    # like tracing — both passive. The SLO objective: time-to-ready p95 ≤
+    # slo_target_seconds with multi-window burn alerts (fast 5m / slow 1h).
+    fleet_enabled: bool = True
+    slo_target_seconds: float = 600.0
+    slo_fast_burn_threshold: float = 14.4
+    flight_recorder_enabled: bool = True
+    recorder_capacity: int = 2048
+    # Where anomaly bundles are written ("" = memory only, HTTP serving
+    # still works).
+    bundle_dir: str = ""
     simulate: bool = False
     simulate_claims: int = 0
     simulate_shape: str = "tpu-v5e-8"
@@ -170,6 +182,14 @@ def parse_options(argv=None, env=None) -> Options:
         shard_index=_shard_index_env(e),
         tracing_enabled=_env_bool(e, "TRACING_ENABLED", True),
         trace_buffer=int(e.get("TRACE_BUFFER", "512")),
+        fleet_enabled=_env_bool(e, "FLEET_SLO_ENABLED", True),
+        slo_target_seconds=float(e.get("SLO_TARGET_SECONDS", "600")),
+        slo_fast_burn_threshold=float(
+            e.get("SLO_FAST_BURN_THRESHOLD", "14.4")),
+        flight_recorder_enabled=_env_bool(
+            e, "FLIGHT_RECORDER_ENABLED", True),
+        recorder_capacity=int(e.get("RECORDER_CAPACITY", "2048")),
+        bundle_dir=e.get("DEBUG_BUNDLE_DIR", ""),
     )
     o.feature_gates = parse_feature_gates(e.get("FEATURE_GATES", ""), o.feature_gates)
 
@@ -186,6 +206,17 @@ def parse_options(argv=None, env=None) -> Options:
                    default=not o.tracing_enabled,
                    help="turn off claimtrace (per-claim lifecycle traces)")
     p.add_argument("--trace-buffer", type=int, default=o.trace_buffer)
+    p.add_argument("--disable-fleet-slo", action="store_true",
+                   default=not o.fleet_enabled,
+                   help="turn off the fleet SLO aggregator (/slo)")
+    p.add_argument("--slo-target-seconds", type=float,
+                   default=o.slo_target_seconds,
+                   help="time-to-ready p95 objective target")
+    p.add_argument("--disable-flight-recorder", action="store_true",
+                   default=not o.flight_recorder_enabled,
+                   help="turn off the flight recorder (/debugz/bundle)")
+    p.add_argument("--debug-bundle-dir", default=o.bundle_dir,
+                   help="directory for anomaly bundles ('' = memory only)")
     p.add_argument("--simulate", action="store_true",
                    help="run against the in-process simulated cloud (envtest)")
     p.add_argument("--simulate-claims", type=int, default=0,
@@ -202,6 +233,10 @@ def parse_options(argv=None, env=None) -> Options:
     o.shard_index = args.shard_index
     o.tracing_enabled = not args.disable_tracing
     o.trace_buffer = args.trace_buffer
+    o.fleet_enabled = not args.disable_fleet_slo
+    o.slo_target_seconds = args.slo_target_seconds
+    o.flight_recorder_enabled = not args.disable_flight_recorder
+    o.bundle_dir = args.debug_bundle_dir
     if not 0 <= o.shard_index < o.shards:
         p.error(f"--shard-index {o.shard_index} outside [0, {o.shards})")
     o.simulate = args.simulate

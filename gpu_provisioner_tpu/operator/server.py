@@ -8,8 +8,15 @@ pprof analog behind --enable-profiling: /debug/tasks dumps live asyncio tasks
 with stacks (operator.go:185-200 exposes Go pprof there).
 
 Claimtrace surface (observability/): when a TraceStore is wired, /traces
-returns recent trace summaries and /traces/{claim} the full waterfall (JSON;
+returns recent trace summaries (``?limit=`` bounds the payload, ``?since=``
+filters to traces active after a loop-clock cursor — mega-wave scale makes
+both necessary) and /traces/{claim} the full waterfall (JSON;
 ``?format=text`` renders the plain-text bars).
+
+fleetscope surface (PR 14): /slo serves the fleet aggregator's snapshot
+(digest percentiles per placement key, objective burn state) and
+/debugz/bundle the flight recorder's anomaly bundles (most recent by
+default, ``?trigger=`` for a specific one, ``?list=1`` for all).
 """
 
 from __future__ import annotations
@@ -46,7 +53,7 @@ BUILD_INFO.labels(version=__version__,
 
 
 def build_apps(manager: Manager, enable_profiling: bool = False,
-               trace_store=None):
+               trace_store=None, fleet=None, recorder=None):
     metrics = web.Application()
 
     async def metrics_handler(_req):
@@ -63,11 +70,19 @@ def build_apps(manager: Manager, enable_profiling: bool = False,
 
         async def traces_handler(req):
             try:
-                n = int(req.query.get("n", "50"))
+                # ?limit= is the documented name; ?n= predates it and stays
+                # accepted (dashboards already link it)
+                n = int(req.query.get("limit", req.query.get("n", "50")))
+                since = float(req.query.get("since", "0"))
             except ValueError:
-                return web.Response(status=400, text="bad n")
+                return web.Response(status=400, text="bad limit/since")
+            traces = trace_store.recent(n)
+            if since > 0:
+                # loop-clock cursor: only traces with activity after it —
+                # pair with the summaries' own last_at for incremental polls
+                traces = [t for t in traces if t.last_at() > since]
             return web.json_response(
-                {"traces": [t.summary() for t in trace_store.recent(n)]})
+                {"traces": [t.summary() for t in traces]})
 
         async def trace_handler(req):
             trace = trace_store.get(req.match_info["claim"])
@@ -79,6 +94,30 @@ def build_apps(manager: Manager, enable_profiling: bool = False,
 
         metrics.router.add_get("/traces", traces_handler)
         metrics.router.add_get("/traces/{claim}", trace_handler)
+
+    if fleet is not None:
+        async def slo_handler(_req):
+            return web.json_response(fleet.snapshot())
+
+        metrics.router.add_get("/slo", slo_handler)
+
+    if recorder is not None:
+        async def bundle_handler(req):
+            if req.query.get("list"):
+                return web.json_response(
+                    {"stats": recorder.stats(),
+                     "bundles": recorder.bundles()})
+            bundle = recorder.bundle(req.query.get("trigger"))
+            if bundle is None:
+                return web.Response(status=404, text="no bundle recorded")
+            return web.json_response(bundle)
+
+        async def recorder_events_handler(_req):
+            return web.json_response({"stats": recorder.stats(),
+                                      "events": recorder.events()})
+
+        metrics.router.add_get("/debugz/bundle", bundle_handler)
+        metrics.router.add_get("/debugz/events", recorder_events_handler)
 
     if enable_profiling:
         from . import profiling
@@ -129,9 +168,11 @@ def build_apps(manager: Manager, enable_profiling: bool = False,
 
 
 async def start_servers(manager: Manager, metrics_port: int, health_port: int,
-                        enable_profiling: bool = False, trace_store=None):
+                        enable_profiling: bool = False, trace_store=None,
+                        fleet=None, recorder=None):
     metrics_app, health_app = build_apps(manager, enable_profiling,
-                                         trace_store=trace_store)
+                                         trace_store=trace_store,
+                                         fleet=fleet, recorder=recorder)
     runners = []
     for app, port in ((metrics_app, metrics_port), (health_app, health_port)):
         runner = web.AppRunner(app, access_log=None)

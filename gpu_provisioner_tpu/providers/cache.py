@@ -233,6 +233,15 @@ class TTLMemo:
     def __len__(self) -> int:
         return len(self._stamps)
 
+    def live(self) -> dict[str, float]:
+        """Every key with a live memo → seconds of suppression remaining.
+        Pure read like ``remaining`` (no stats, no expiry) — the flight
+        recorder snapshots this into diagnostic bundles."""
+        now = self._now()
+        return {k: round(self.ttl - (now - stamp), 4)
+                for k, stamp in self._stamps.items()
+                if self.ttl > 0 and now - stamp < self.ttl}
+
 
 class CountingAPI:
     """Transparent per-endpoint call counter around a cloud API seam

@@ -429,9 +429,13 @@ class InstanceProvider:
             for cand in candidates:
                 if cand.key in attempted:
                     dry.append(cand.key)
+                    probes.emit("placement-verdict", name,
+                                verdict="attempted-skip", candidate=cand.key)
                     continue
                 if self.placement.suppressed(cand):
                     dry.append(cand.key)
+                    probes.emit("placement-verdict", name,
+                                verdict="memo-suppressed", candidate=cand.key)
                     if self.cfg.stockout_park:
                         rem = self.placement.suppressed_remaining(cand)
                         if rem > 0 and (park_wait is None or rem < park_wait):
@@ -457,6 +461,9 @@ class InstanceProvider:
                         # minus its blind wait).
                         log.info("nodepool %s create already in progress, "
                                  "adopting", name)
+                        probes.emit("placement-verdict", name,
+                                    verdict="conflict-adopt",
+                                    candidate=cand.key)
                         if self.tracker is not None:
                             self._register_create(name, cand.shape.hosts)
                             raise CreateError(
@@ -469,6 +476,8 @@ class InstanceProvider:
                         # a TTL) and record it on the claim (restart resumes
                         # at the NEXT candidate)
                         self.placement.note_stockout(cand)
+                        probes.emit("placement-verdict", name,
+                                    verdict="stockout", candidate=cand.key)
                         await self._record_attempt(nc, cand.key)
                         dry.append(cand.key)
                         last_err = e
@@ -479,6 +488,8 @@ class InstanceProvider:
                 break
         if chosen is None:
             if park_wait is not None:
+                probes.emit("placement-verdict", name, verdict="parked",
+                            wait=round(park_wait, 4))
                 # Every non-attempted candidate is only TEMPORARILY dry (a
                 # live memo, no probe spent): park the claim — retryable
                 # error onto the backoff ladder as the safety net, with the
@@ -499,11 +510,23 @@ class InstanceProvider:
                 raise InsufficientCapacityError(
                     f"nodepool {name} ({candidates[0].shape.slice_name}): "
                     f"{detail}") from last_err
+            probes.emit("placement-verdict", name, verdict="exhausted",
+                        candidates=len(candidates))
             raise CreateError(
                 f"nodepool {name}: capacity exhausted across all "
                 f"{len(candidates)} placement candidates "
                 f"({', '.join(dry)})",
                 reason=REASON_STOCKOUT) from last_err
+        probes.emit("placement-verdict", name,
+                    verdict="fallback" if chosen is not candidates[0]
+                    else "chosen", candidate=chosen.key)
+        if self.tracer is not None:
+            # Stamp the placement key axes on the claim's trace — the fleet
+            # SLO aggregator digests time-to-ready per {zone, generation,
+            # tier} off exactly these attrs.
+            self.tracer.set_trace_attrs(
+                name, zone=chosen.zone,
+                generation=chosen.shape.generation, tier=chosen.tier)
         if chosen is not candidates[0]:
             self.placement.note_fallback(candidates[0], chosen)
             log.info("nodepool %s fell back to %s (wanted %s)",

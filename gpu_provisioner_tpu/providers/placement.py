@@ -182,3 +182,19 @@ class PlacementEngine:
 
     def note_fallback(self, wanted: Candidate, placed: Candidate) -> None:
         FALLBACKS[(wanted.zone, placed.zone)] += 1
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        """Diagnostic view for flight-recorder bundles: live stockout memos
+        (zone/generation → seconds left), cumulative stockout / fallback /
+        preemption tallies, and which zones are currently spot-demoted."""
+        return {
+            "zones": list(self.zones),
+            "stockout_memos": self.memo.live(),
+            "stockouts": dict(STOCKOUTS),
+            "fallbacks": {f"{a}->{b}": n
+                          for (a, b), n in FALLBACKS.items()},
+            "spot_preemptions": dict(SPOT_PREEMPTIONS),
+            "spot_demoted": [z for z in self.zones
+                             if self.spot_demoted(z)],
+        }

@@ -232,6 +232,7 @@ class Controller:
                 # incarnation must not resume this item with a stale failure
                 # counter pinned at max backoff — the drop is not a failure.
                 self.fenced_total += 1
+                probes.emit("fence-drop", req, controller=self.name)
                 await self.queue.forget(req)
                 await self.queue.done(req)
                 continue

@@ -1,25 +1,31 @@
-"""Ordering probes: the schedfuzz observation seam (analysis/schedfuzz.py).
+"""Ordering probes: the schedfuzz observation seam (analysis/schedfuzz.py)
+plus the flight recorder's event tap (observability/flightrecorder.py).
 
 The interleaving explorer checks happens-before contracts the control plane
 already relies on — cache-apply before handler delivery, meta patch before
 status patch, fence check before cloud mutate, ``WakeHub.stop()`` before any
 late wake. Those contracts live at seams spread across runtime/, providers/
-and controllers/; this module is the one place they report to.
+and controllers/; this module is the one place they report to. Since PR 14
+the same seam also feeds the flight recorder's bounded ring of semantic
+control-plane events, so ``emit`` fans out to a small tuple of sinks.
 
 Design constraints, in order:
 
 - **Zero cost disarmed.** ``emit()`` is a module-global ``None`` check; the
-  call sites pay a few attribute loads for the arguments. Nothing here
-  allocates, imports analysis code, or runs by default — the probes are
-  passive the same way the claimtrace tracer is.
-- **No layering leak.** runtime code must not import analysis/ (or anything
-  above itself — provgraph PG001 enforces exactly that); the explorer arms
-  the seam from outside via :func:`arm`.
+  call sites pay a few attribute loads for the arguments. With neither a
+  fuzz probe armed nor a recorder sink added, ``_active`` is ``None`` and
+  nothing allocates or iterates — the probes are passive the same way the
+  claimtrace tracer is (tests/test_fleet.py pins this structurally).
+- **No layering leak.** runtime code must not import analysis/ or
+  observability/ (or anything above itself — provgraph PG001 enforces
+  exactly that); the explorer and the recorder both arm the seam from
+  outside via :func:`arm` / :func:`add_sink`.
 - **Synchronous.** A probe fires inline at the seam it observes, so the
   checker sees events in true program order — the whole point. Probe
   callbacks must not await, block, or raise (a raising probe is a bug in
   the harness, not the product; ``emit`` lets it propagate so the fuzz run
-  fails loudly instead of silently dropping evidence).
+  fails loudly instead of silently dropping evidence — recorder sinks
+  guard their own bodies for the same reason).
 """
 
 from __future__ import annotations
@@ -29,29 +35,62 @@ from typing import Callable, Optional
 # probe(event: str, key, **info) — armed by analysis/schedfuzz, or by tests.
 Probe = Callable[..., None]
 
+# The legacy single slot (schedfuzz's arm/disarm nesting contract) and the
+# persistent sinks (flight recorders). ``_active`` is the merged tuple —
+# rebuilt on every arm/disarm/add/remove, so the emit fast path stays ONE
+# module-global load and ``None`` check.
 _probe: Optional[Probe] = None
+_sinks: tuple[Probe, ...] = ()
+_active: Optional[tuple[Probe, ...]] = None
+
+
+def _rebuild() -> None:
+    global _active
+    merged = (() if _probe is None else (_probe,)) + _sinks
+    _active = merged or None
 
 
 def arm(probe: Probe) -> Optional[Probe]:
-    """Install ``probe`` as the active sink; returns the previous one so
-    nested harnesses can restore it."""
+    """Install ``probe`` as the active fuzz sink; returns the previous one
+    so nested harnesses can restore it. Recorder sinks are unaffected."""
     global _probe
     prev = _probe
     _probe = probe
+    _rebuild()
     return prev
 
 
 def disarm(prev: Optional[Probe] = None) -> None:
-    """Remove the active probe (or restore ``prev`` from :func:`arm`)."""
+    """Remove the active fuzz probe (or restore ``prev`` from :func:`arm`)."""
     global _probe
     _probe = prev
+    _rebuild()
 
 
 def armed() -> bool:
     return _probe is not None
 
 
+def add_sink(sink: Probe) -> None:
+    """Append a persistent sink (a flight recorder). Idempotent."""
+    global _sinks
+    if sink not in _sinks:
+        _sinks = _sinks + (sink,)
+        _rebuild()
+
+
+def remove_sink(sink: Probe) -> None:
+    """Detach a persistent sink; unknown sinks are a no-op (teardown paths
+    call this unconditionally). Equality, not identity — callers pass bound
+    methods, and each attribute access builds a fresh (but ``==``) one."""
+    global _sinks
+    if sink in _sinks:
+        _sinks = tuple(s for s in _sinks if s != sink)
+        _rebuild()
+
+
 def emit(event: str, key, **info) -> None:
-    p = _probe
-    if p is not None:
-        p(event, key, **info)
+    a = _active
+    if a is not None:
+        for p in a:
+            p(event, key, **info)

@@ -35,6 +35,40 @@ BREAKER_HALF_OPEN = "half-open"
 # the entry — the newest client owns the gauge.
 BREAKERS: dict[str, "CircuitBreaker"] = {}
 
+# Open-transition listeners: ``fn(name, state=..., failures=...)`` fired
+# whenever any breaker opens (fresh open or failed half-open probe). The
+# flight recorder's breaker-trip trigger attaches here — transport sits
+# BELOW runtime in the layering, so it cannot reach the runtime/probes
+# seam; it carries its own tiny listener list instead, armed from outside
+# (envtest / operator main) exactly like probes sinks. Listener errors are
+# swallowed: observability must never fail a request path.
+_breaker_listeners: list = []
+
+
+def add_breaker_listener(fn) -> None:
+    """Register ``fn(name, **info)`` for breaker open transitions
+    (idempotent)."""
+    if fn not in _breaker_listeners:
+        _breaker_listeners.append(fn)
+
+
+def remove_breaker_listener(fn) -> None:
+    """Detach a listener; unknown listeners are a no-op."""
+    try:
+        _breaker_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_breaker_opened(breaker: "CircuitBreaker", state: str) -> None:
+    for fn in list(_breaker_listeners):
+        try:
+            fn(breaker.name, state=state,
+               failures=breaker.consecutive_failures,
+               retry_after=round(breaker.retry_after(), 3))
+        except Exception:  # noqa: BLE001 — listeners must not break I/O
+            pass
+
 
 class BreakerOpenError(Exception):
     """The circuit breaker refused the call without touching the network.
@@ -128,10 +162,12 @@ class CircuitBreaker:
             # failed probe: re-open for a fresh window
             self._opened_at = self._clock()
             self._probe_inflight = False
+            _notify_breaker_opened(self, "reopened")
         elif (self._opened_at is None
                 and self._failures >= self.failure_threshold):
             self._opened_at = self._clock()
             self.opened_total += 1
+            _notify_breaker_opened(self, "opened")
 
     def unregister(self) -> None:
         """Drop this breaker from the metrics registry (client close): stale

@@ -1,0 +1,394 @@
+"""fleetscope tests: SLO digests/burn-rate units, probe fan-out structure,
+flight-recorder ring/trigger semantics, and the chaos-triggered bundle
+round-trips (trigger → ring → disk → HTTP → parse).
+
+The chaos soaks reuse test_chaos's seeded env builder; bundle triggers are
+forced deterministically — a microsecond SLO target makes every envtest
+claim a violation (fast-burn), and a near-zero mass-repair fraction makes
+the first preempted spot node trip the repair breaker."""
+
+import asyncio
+import gc
+import json
+
+import pytest
+
+from gpu_provisioner_tpu import chaos
+from gpu_provisioner_tpu.controllers.metrics import (
+    SLO_BURN_RATE, SLO_CLAIMS_OBSERVED, SLO_OBJECTIVE_TARGET,
+    SLO_VIOLATIONS_TOTAL, TIMER_WAKE_SHARE, update_runtime_gauges,
+)
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.observability import Tracer, TraceStore
+from gpu_provisioner_tpu.observability.fleet import (
+    BUCKET_BOUNDS, ENGINES, BurnWindow, FleetAggregator, LatencyDigest,
+    SLOObjective, SLOTracker, engine_stats, register_engine,
+)
+from gpu_provisioner_tpu.observability.flightrecorder import (
+    RECORDED_EVENTS, FlightRecorder,
+)
+from gpu_provisioner_tpu.runtime import probes
+
+from .conftest import async_test
+from .test_chaos import SEED, chaos_env, converge
+from .test_placement import ZONE_A, ZONE_B, ZONE_C, spot_claim
+
+# ------------------------------------------------------------- digest units
+
+
+def test_latency_digest_quantiles_and_flat_memory():
+    d = LatencyDigest()
+    for i in range(1, 101):
+        d.record(i / 100.0)           # 0.01 .. 1.00
+    assert d.count == 100
+    assert d.min == 0.01 and d.max == 1.0
+    # the geometric ladder guarantees ~11% relative error per bucket
+    assert abs(d.quantile(0.50) - 0.50) <= 0.50 * 0.15
+    assert abs(d.quantile(0.95) - 0.95) <= 0.95 * 0.15
+    assert d.quantile(1.0) == 1.0
+    assert abs(d.mean - 0.505) < 1e-9
+    # memory is O(buckets), not O(observations): 100× more samples, same
+    # structure — the BENCH_pr14 flatness property at unit scale
+    big = LatencyDigest()
+    for i in range(10_000):
+        big.record((i % 100 + 1) / 100.0)
+    assert len(big.counts) == len(d.counts) == len(BUCKET_BOUNDS) + 1
+    # single-sample digest reports the sample itself (min/max clamp)
+    one = LatencyDigest()
+    one.record(0.5)
+    assert one.quantile(0.5) == one.quantile(0.99) == 0.5
+    assert LatencyDigest().quantile(0.95) == 0.0
+    s = d.summary()
+    assert s["count"] == 100 and s["max"] == 1.0
+
+
+def test_burn_window_slides_and_expires():
+    t = {"now": 0.0}
+    w = BurnWindow(10.0, clock=lambda: t["now"])
+    for _ in range(4):
+        w.note(ok=False)
+    w.note(ok=True)
+    assert w.counts() == (1, 4)
+    assert w.bad_fraction() == pytest.approx(0.8)
+    # everything ages out once the window has fully slid past
+    t["now"] = 11.0
+    assert w.counts() == (0, 0)
+    assert w.bad_fraction() == 0.0
+
+
+def test_slo_tracker_multi_window_alert_and_rearm():
+    t = {"now": 0.0}
+    obj = SLOObjective(target=1.0, percentile=0.95, fast_window=10.0,
+                       slow_window=100.0, burn_threshold=1.0, min_samples=3)
+    trk = SLOTracker(obj, clock=lambda: t["now"])
+    trk.note(5.0)
+    trk.note(5.0)
+    # two violations are under min_samples — burn ∞ into an empty window
+    # is noise, not an incident
+    assert not trk.fast_burning()
+    trk.note(5.0)
+    assert trk.fast_burning()
+    burn = trk.burn_rates()
+    assert burn["fast"] >= 1.0 and burn["slow"] >= 1.0
+    assert trk.bad == 3 and trk.good == 0
+    # the fast window slides clean; a healthy stretch clears the alert
+    # even though the slow window still remembers the incident
+    t["now"] = 12.0
+    for _ in range(5):
+        trk.note(0.1)
+    assert not trk.fast_burning()
+    d = trk.to_dict()
+    assert d["violations"] == 3 and d["good"] == 5
+
+
+@async_test
+async def test_fleet_aggregator_keys_fast_burn_fires_once():
+    store = TraceStore()
+    tracer = Tracer(store)
+    agg = FleetAggregator(objectives=(SLOObjective(
+        target=1e-9, fast_window=30.0, slow_window=60.0,
+        burn_threshold=0.1, min_samples=1),))
+    tracer.add_listener(agg.on_trace_event)
+    fired = []
+    agg.on_fast_burn = fired.append
+
+    for claim in ("fa0", "fa1"):
+        with tracer.span(claim, "reconcile"):
+            await asyncio.sleep(0.002)
+        tracer.set_trace_attrs(claim, zone="z1", generation="v5e",
+                               tier="spot")
+        tracer.annotate(claim, "ready")
+    assert agg.claims_observed == 2
+    assert ("z1", "v5e", "spot", "0") in agg.digests
+    # the alert fires on the TRANSITION into burn — the second violating
+    # claim arrives already-burning and must not re-trigger
+    assert len(fired) == 1 and fired[0].objective.name == "time-to-ready"
+    snap = agg.snapshot()
+    assert snap["keys"][0]["zone"] == "z1"
+    assert snap["objectives"][0]["violations"] == 2
+    assert snap["objectives"][0]["fast_burning"]
+    # a trace that never reached ready (or has no analyzable window)
+    # counts as unattributed, not a crash
+    tracer.annotate("fa-empty", "ready")
+    assert agg.unattributed == 1
+
+
+# ------------------------------------------------------- flight recorder units
+
+
+def test_recorder_ring_bounds_and_event_filter():
+    rec = FlightRecorder(capacity=4)
+    rec.probe("wq-enqueue", "hot", n=1)       # hot-path event: not recorded
+    assert rec.events_recorded == 0
+    for i in range(10):
+        rec.probe("hub-wake", f"w{i}", source="watch")
+    assert rec.events_recorded == 10
+    assert len(rec.events()) == 4, "ring must stay bounded"
+    assert [e["key"] for e in rec.events()] == ["w6", "w7", "w8", "w9"]
+    assert "hub-wake" in RECORDED_EVENTS and "wq-enqueue" not in RECORDED_EVENTS
+
+
+def test_recorder_trigger_dedupe_and_sources():
+    rec = FlightRecorder(capacity=16)
+    rec.add_source("ok", lambda: {"depth": 3})
+    rec.add_source("broken", lambda: 1 / 0)
+    rec.probe("hub-wake", "w0", source="timer")
+    b = rec.trigger("breaker-trip", key="gke-nodepools")
+    assert b is not None
+    assert b["sources"]["ok"] == {"depth": 3}
+    assert "error" in b["sources"]["broken"], \
+        "a failing source must degrade, not fail the snapshot"
+    assert b["events"][0]["event"] == "hub-wake"
+    # exactly one bundle per distinct (kind, key): repeats are counted
+    assert rec.trigger("breaker-trip", key="gke-nodepools") is None
+    assert rec.triggers_suppressed == 1
+    assert rec.trigger("breaker-trip", key="cloudtpu") is not None
+    assert len(rec.bundles()) == 2
+    assert rec.bundle("breaker-trip:gke-nodepools") is b
+    assert rec.bundle() is rec.bundle("breaker-trip:cloudtpu")
+    assert rec.bundle("no-such") is None
+    # non-JSON info values are coerced, never poison serialization
+    rec.probe("fence-drop", object(), controller=object())
+    json.dumps(rec.events())
+    stats = rec.stats()
+    assert stats["bundles"] == 2 and stats["triggers_suppressed"] == 1
+
+
+def test_probe_fanout_structure_single_none_check():
+    """The disabled fast path must stay ONE module-global None check; a fuzz
+    probe and a recorder sink must coexist and detach independently."""
+    assert probes._active is None, "a prior test leaked a probe/sink"
+    seen_probe, seen_sink = [], []
+
+    def fuzz(event, key, **info):
+        seen_probe.append(event)
+
+    def sink_fn(event, key, **info):
+        seen_sink.append(event)
+
+    probes.add_sink(sink_fn)
+    probes.add_sink(sink_fn)                       # idempotent
+    assert probes._active == (sink_fn,)
+    prev = probes.arm(fuzz)
+    probes.emit("x", "k")
+    assert seen_probe == ["x"] and seen_sink == ["x"]
+    probes.disarm(prev)
+    probes.emit("y", "k")
+    assert seen_probe == ["x"] and seen_sink == ["x", "y"]
+    probes.remove_sink(sink_fn)
+    probes.remove_sink(sink_fn)                    # unknown: no-op
+    assert probes._active is None
+
+
+@async_test
+async def test_disabled_recorder_and_fleet_leave_seams_dark():
+    """fleet=False/flight_recorder=False: no aggregator, no sink — the
+    probe seam reads None for the whole run and /slo, /debugz/* are not
+    routed."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from gpu_provisioner_tpu.operator.server import build_apps
+
+    async with Env(EnvtestOptions(fleet=False, flight_recorder=False)) as env:
+        assert env.fleet is None and env.flight_recorder is None
+        assert probes._active is None, \
+            "disabled observability must cost exactly the None check"
+        await env.client.create(make_nodeclaim("dk0"))
+        await env.wait_ready("dk0")
+        assert probes._active is None
+        metrics_app, _ = build_apps(env.manager,
+                                    trace_store=env.trace_store)
+        async with TestClient(TestServer(metrics_app)) as mc:
+            assert (await mc.get("/slo")).status == 404
+            assert (await mc.get("/debugz/bundle")).status == 404
+    assert probes._active is None
+
+
+# ------------------------------------------------------ engine-stats bridge
+
+
+def test_engine_registry_weak_and_gauges():
+    class FakeEngine:
+        def stats(self):
+            return {"slots": 8, "slots_active": 3, "queue_depth": 5,
+                    "requests_submitted": 40, "requests_finished": 37,
+                    "tokens_emitted": 1234, "prefix_cache_entries": 7,
+                    "prefix_cache_hits": 20, "prefix_cache_misses": 4}
+
+    eng = FakeEngine()
+    name = register_engine(eng, name="unit-engine")
+    assert name == "unit-engine"
+    assert engine_stats()["unit-engine"]["queue_depth"] == 5
+    from gpu_provisioner_tpu.controllers.metrics import (
+        ENGINE_PREFIX_CACHE, ENGINE_QUEUE_DEPTH, ENGINE_SLOTS,
+    )
+    update_runtime_gauges(object())    # no manager: registry sampling only
+    assert ENGINE_QUEUE_DEPTH.labels("unit-engine")._value.get() == 5
+    assert ENGINE_SLOTS.labels("unit-engine", "active")._value.get() == 3
+    assert ENGINE_PREFIX_CACHE.labels(
+        "unit-engine", "hits")._value.get() == 20
+    # weak registry: a collected engine drops out of the scrape instead of
+    # freezing its last values behind a dead name
+    del eng
+    gc.collect()
+    assert "unit-engine" not in engine_stats()
+    assert "unit-engine" not in ENGINES
+
+
+# ------------------------------------------------------------- chaos soaks
+
+WAVE = 10
+
+
+@pytest.mark.chaos
+@pytest.mark.capacity
+@async_test
+async def test_zonal_stockout_fast_burn_bundle_round_trip(tmp_path):
+    """The acceptance round-trip under seeded zonal_stockout: a microsecond
+    SLO target turns every ready claim into a violation, the fast-burn
+    trigger snapshots exactly one bundle, and the bundle round-trips
+    trigger → disk → HTTP → parse byte-identically."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from gpu_provisioner_tpu.operator.server import build_apps
+
+    policy = chaos.profile("zonal_stockout", seed=SEED)
+    zones = {
+        ZONE_A: {"v5e": 8},          # room for exactly one slice
+        ZONE_B: {"v5e": 10_000},     # ample chips — but chaos-dry
+        ZONE_C: {"v5e": 10_000},
+    }
+    objective = SLOObjective(target=1e-6, fast_window=30.0, slow_window=60.0,
+                             burn_threshold=1.0, min_samples=3)
+    violations0 = SLO_VIOLATIONS_TOTAL.labels("time-to-ready")._value.get()
+    names = [f"fb{i}" for i in range(WAVE)]
+    async with chaos_env(policy, launch_timeout=30.0, zones=zones,
+                         stockout_memo_ttl=30.0,
+                         slo_objectives=(objective,),
+                         bundle_dir=str(tmp_path)) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        ready, gone = await converge(env, names, timeout=45.0)
+        assert ready == set(names), f"wave lost claims: {sorted(gone)}"
+
+        snap = env.fleet.snapshot()
+        assert snap["claims_observed"] == WAVE
+        assert snap["objectives"][0]["violations"] == WAVE
+        landed = {k["zone"] for k in snap["keys"]}
+        assert landed <= {ZONE_A, ZONE_C}, f"digest keys: {landed}"
+        assert snap["phases"], "phase attribution never populated"
+
+        rec = env.flight_recorder
+        burn_bundles = [b for b in rec.bundles()
+                        if b["trigger"]["kind"] == "slo-fast-burn"]
+        assert len(burn_bundles) == 1, \
+            f"want exactly one fast-burn bundle, got {len(burn_bundles)}"
+        bundle = burn_bundles[0]
+        assert bundle["trigger"]["key"] == "slo-fast-burn:time-to-ready"
+        kinds = {e["event"] for e in bundle["events"]}
+        assert "placement-verdict" in kinds, sorted(kinds)
+        for section in ("queue_depths", "inflight_ops", "placement_memos",
+                        "recent_traces"):
+            assert section in bundle["sources"], bundle["sources"].keys()
+        assert ZONE_B in bundle["sources"]["placement_memos"]["stockouts"]
+
+        # disk leg: the trigger wrote exactly this bundle
+        files = sorted(tmp_path.glob("bundle-*-slo-fast-burn*.json"))
+        assert len(files) == 1, [f.name for f in files]
+        assert json.loads(files[0].read_text()) == bundle
+        assert rec.bundles_written >= 1
+
+        # HTTP leg: /slo and /debugz/bundle serve the same objects
+        metrics_app, _ = build_apps(env.manager, trace_store=env.trace_store,
+                                    fleet=env.fleet, recorder=rec)
+        async with TestClient(TestServer(metrics_app)) as mc:
+            slo = await (await mc.get("/slo")).json()
+            assert slo["claims_observed"] == WAVE
+            assert slo["objectives"][0]["target_s"] == pytest.approx(1e-6)
+            r = await mc.get("/debugz/bundle?trigger=slo-fast-burn:time-to-ready")
+            assert r.status == 200
+            assert await r.json() == bundle
+            listing = await (await mc.get("/debugz/bundle?list=1")).json()
+            assert listing["stats"]["bundles"] == len(rec.bundles())
+            assert (await mc.get("/debugz/bundle?trigger=nope")).status == 404
+            # /traces pagination satellite: ?limit= bounds, ?since= filters
+            page = await (await mc.get("/traces?limit=3")).json()
+            assert len(page["traces"]) == 3
+            cursor = max(t["last_at"] for t in page["traces"])
+            newer = await (await mc.get(
+                f"/traces?limit=50&since={cursor + 1e9}")).json()
+            assert newer["traces"] == []
+            assert (await mc.get("/traces?since=bogus")).status == 400
+
+        # scrape satellites: timer-wake share + SLO families go live
+        update_runtime_gauges(env.manager)
+        assert 0.0 <= TIMER_WAKE_SHARE._value.get() <= 1.0
+        assert SLO_CLAIMS_OBSERVED._value.get() >= WAVE
+        assert SLO_OBJECTIVE_TARGET.labels(
+            "time-to-ready")._value.get() == pytest.approx(1e-6)
+        assert SLO_BURN_RATE.labels(
+            "time-to-ready", "fast")._value.get() >= 0.0
+        assert (SLO_VIOLATIONS_TOTAL.labels("time-to-ready")._value.get()
+                >= violations0 + WAVE)
+
+
+@pytest.mark.chaos
+@pytest.mark.capacity
+@async_test
+async def test_spot_reclaim_repair_breaker_trip_bundles_once():
+    """spot_reclaim preempts every spot slice; with the mass-repair breaker
+    tuned to trip on the first unhealthy node, the trip must snapshot
+    exactly one bundle whose ring already holds the wave's placement
+    verdicts — and repeats of the same trigger are suppressed, not
+    re-bundled."""
+    policy = chaos.profile("spot_reclaim", seed=SEED)
+    names = ["sb0", "sb1"]
+    async with chaos_env(policy, launch_timeout=20.0,
+                         repair_toleration=0.2,
+                         spot_reclaim_grace=1.0,
+                         repair_max_unhealthy_fraction=0.01,
+                         repair_breaker_min_unhealthy=1) as env:
+        for n in names:
+            await env.client.create(spot_claim(n))
+        ready, _ = await converge(env, names, timeout=20.0)
+        assert ready == set(names)
+        rec = env.flight_recorder
+        deadline = asyncio.get_event_loop().time() + 15.0
+        while rec.bundle("repair-breaker-trip:cluster") is None:
+            assert asyncio.get_event_loop().time() < deadline, \
+                f"repair breaker never tripped: {rec.stats()}"
+            await asyncio.sleep(0.05)
+        trips = [b for b in rec.bundles()
+                 if b["trigger"]["kind"] == "repair-breaker-trip"]
+        assert len(trips) == 1, "one distinct trigger, one bundle"
+        bundle = trips[0]
+        verdicts = [e for e in bundle["events"]
+                    if e["event"] == "placement-verdict"]
+        assert {v["key"] for v in verdicts} >= set(names), \
+            "the bundle must carry the wave's placement verdicts"
+        assert "queue_depths" in bundle["sources"]
+        # a second trip of the SAME (kind, key) is deduped and counted
+        suppressed0 = rec.triggers_suppressed
+        assert rec.trigger("repair-breaker-trip", key="cluster") is None
+        assert rec.triggers_suppressed == suppressed0 + 1
+        assert len([b for b in rec.bundles()
+                    if b["trigger"]["kind"] == "repair-breaker-trip"]) == 1
