@@ -59,8 +59,12 @@ fuzz: ## Deterministic interleaving sweep: schedfuzz scenarios under FUZZ_SEEDS 
 	$(PY) -m gpu_provisioner_tpu.analysis.schedfuzz --seeds $(FUZZ_SEEDS)
 
 .PHONY: chaos
-chaos: fuzz ## Interleaving sweep, then the chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_MODES.md)
+chaos: fuzz brownout ## Interleaving sweep + apiserver-fault soaks, then the chaos soak suite + one crash-restart smoke, fixed seed (docs/FAILURE_MODES.md)
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py tests/test_recovery.py -q -m chaos
+
+.PHONY: brownout
+brownout: ## Apiserver-fault soaks: brownout/partition/watch-gap profiles + the 200-claim 30s-partition acceptance soak
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_apifaults.py -q -m chaos
 
 .PHONY: recover
 recover: ## Crash-restart recovery soaks: crash-point matrix + fenced leader failover
@@ -86,10 +90,11 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12/pr14 gates
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12/pr14/pr16 gates
 	$(PY) -m bench.bench_megawave --gate
 	$(PY) -m bench.bench_provision
 	$(PY) -m bench.bench_fleet --gate
+	$(PY) -m bench.bench_apifaults --gate
 
 .PHONY: slo
 slo: ## fleetscope suite: SLO engine + flight-recorder tests, then the overhead/memory gate

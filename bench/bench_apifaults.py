@@ -1,0 +1,239 @@
+"""Catch-up-storm benchmark (PR 16): a mid-wave apiserver partition at the
+100-claim reference scale, gated on degraded-mode invariants.
+
+One harness, envtest + FakeCloud + ApiFaultInjector, no network: half the
+wave launches, the apiserver partitions for ``--partition`` seconds while
+the other half is created into the outage (their ADDED events die on the
+dead watch stream), then the heal drives the informer gap-resync and the
+governor's PARTITIONED→CATCHUP→HEALTHY exit. Gates:
+
+- **convergence**: every claim Ready, every pool exists, zero claims lost.
+- **zero duplicate creates**: admitted ``begin_create`` == claims (post-heal
+  re-walks that 409-adopt a live pool are the safe at-least-once answer and
+  do not count).
+- **status writes** ≤ 3.0/claim: the widened shed window plus no-op
+  suppression must absorb the stale-cache re-derivations.
+- **timer wake share** ≤ 0.3 post-heal: the resync's synthesized events
+  carry the catch-up wake load, not the workqueue safety net (steady-state
+  PR 12 bound is 0.05; catch-up legitimately pays in-flight requeues).
+- **partition fencing**: the schedfuzz ``partition-fenced-mutate`` checker
+  replays the probe stream — no cloud mutation inside the fenced window.
+- **flight recorder**: exactly one bundle per degraded mode entered.
+- **wall budget**: 3× headroom over the recorded BENCH_pr16.json wall
+  (scales with machine speed; catches a reintroduced convergence stall).
+
+Usage: python -m bench.bench_apifaults [--gate] [--write]
+                                       [--claims N] [--partition S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+BENCH_PR16_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr16.json"
+
+# PR 16 acceptance gates (criteria, not recorded budgets). The timer bound
+# is the catch-up regime's, not PR 12's steady-state 0.05: claims born into
+# the outage run their whole lifecycle post-heal, and their in-flight
+# safety requeues race event delivery while the CATCHUP pace throttles the
+# backlog (measured 0.04-0.21 across runs and scales; a resync that stops
+# carrying the wake load lands near 1.0). Watch wakes must also outnumber
+# timer wakes outright — see check_gates.
+STATUS_WRITES_PER_CLAIM_MAX = 3.0
+TIMER_WAKE_SHARE_MAX = 0.3
+WALL_BUDGET_FACTOR = 3.0
+
+
+async def catchup_storm(claims: int, partition: float, seed: int) -> dict:
+    from gpu_provisioner_tpu.analysis.schedfuzz import (
+        TraceRecorder, check_partition_fenced_mutate,
+    )
+    from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+    from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+    from gpu_provisioner_tpu.chaos import api_fault_profile
+    from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+    from gpu_provisioner_tpu.runtime import apihealth, probes
+    from gpu_provisioner_tpu.runtime.apihealth import HEALTHY
+    from gpu_provisioner_tpu.runtime.wakehub import SOURCE_TIMER, WAKES
+
+    faults = api_fault_profile("apiserver_partition", seed=seed,
+                               partition_start=0.6,
+                               partition_duration=partition)
+    opts = EnvtestOptions(api_faults=faults, use_informer=True,
+                          node_ready_delay=0.3, node_join_delay=0.1,
+                          gc_interval=0.25, leak_grace=0.25)
+    opts.lifecycle.launch_timeout = max(60.0, partition * 3)
+    opts.lifecycle.registration_timeout = max(60.0, partition * 3)
+    names = [f"cu{i:04d}" for i in range(claims)]
+    ledger_before = dict(apihealth.APIHEALTH)
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    t0 = time.monotonic()
+    try:
+        async with Env(opts) as env:
+            for n in names[: claims // 2]:
+                await env.client.create(make_nodeclaim(n))
+            while not faults.partition_active():
+                await asyncio.sleep(0.02)
+            for n in names[claims // 2:]:
+                await env.client.create(make_nodeclaim(n))
+            while faults.partition_active():
+                await asyncio.sleep(0.1)
+            wakes_at_heal = dict(WAKES)
+            deadline = time.monotonic() + max(90.0, partition * 3)
+            ready: set[str] = set()
+            while ready != set(names):
+                for n in set(names) - ready:
+                    nc = await env.client.get(NodeClaim, n)
+                    if nc.status_conditions.is_true(CONDITION_READY):
+                        ready.add(n)
+                if time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"FAIL converge: {len(ready)}/{claims} ready")
+                await asyncio.sleep(0.05)
+            wall = time.monotonic() - t0
+            gov = env.governor
+            admitted = sum(
+                v for k, v in env.cloud.nodepools.calls.items()
+                if k.startswith("begin_create:"))
+            bundle_modes = sorted(
+                b["trigger"]["key"].split(":", 1)[1]
+                for b in env.flight_recorder.bundles()
+                if b["trigger"]["kind"] == "degraded-mode")
+            delta = {k: WAKES.get(k, 0) - wakes_at_heal.get(k, 0)
+                     for k in WAKES}
+            post_heal_wakes = sum(delta.values())
+            return {
+                "claims": claims,
+                "partition_s": partition,
+                "seed": seed,
+                "wall_s": round(wall, 3),
+                "pools": len(env.cloud.nodepools.pools),
+                "begin_creates_admitted": admitted,
+                "status_writes": env.status_batcher.writes,
+                "writes_per_claim": round(
+                    env.status_batcher.writes / claims, 3),
+                "shed_windows": env.status_batcher.shed_windows,
+                "post_heal_wakes": post_heal_wakes,
+                "timer_wake_share": round(
+                    delta.get(SOURCE_TIMER, 0) / max(post_heal_wakes, 1),
+                    4),
+                "post_heal_wakes_by_source": delta,
+                "governor": {
+                    "entries_total": dict(gov.entries_total),
+                    "throttles_total": gov.throttles_total,
+                    "failures_total": gov.failures_total,
+                },
+                "degraded_modes_entered": sorted(
+                    m for m in gov.entries_total if m != HEALTHY),
+                "degraded_bundles": bundle_modes,
+                "ledger": {k: apihealth.APIHEALTH[k] - ledger_before[k]
+                           for k in apihealth.APIHEALTH},
+                "fuzz_violations": [
+                    f"{v.checker}@{v.seq}: {v.message}"
+                    for v in check_partition_fenced_mutate(rec.events)],
+            }
+    finally:
+        probes.remove_sink(rec)
+
+
+def check_gates(run: dict) -> list[str]:
+    fails: list[str] = []
+    if run["pools"] != run["claims"]:
+        fails.append(f"pools {run['pools']} != claims {run['claims']}")
+    if run["begin_creates_admitted"] != run["claims"]:
+        fails.append(
+            f"duplicate pool creates: {run['begin_creates_admitted']} "
+            f"admitted for {run['claims']} claims")
+    if run["writes_per_claim"] > STATUS_WRITES_PER_CLAIM_MAX:
+        fails.append(
+            f"status-write storm: {run['writes_per_claim']}/claim > "
+            f"{STATUS_WRITES_PER_CLAIM_MAX}")
+    if run["timer_wake_share"] > TIMER_WAKE_SHARE_MAX:
+        fails.append(
+            f"catch-up timer share {run['timer_wake_share']} > "
+            f"{TIMER_WAKE_SHARE_MAX} — the resync is not carrying the "
+            f"wake load")
+    by_source = run["post_heal_wakes_by_source"]
+    if by_source.get("watch", 0) <= by_source.get("timer", 0):
+        fails.append(
+            f"watch wakes did not dominate the catch-up: {by_source}")
+    if "PARTITIONED" not in run["degraded_modes_entered"]:
+        fails.append("partition never tripped the governor")
+    if "CATCHUP" not in run["degraded_modes_entered"]:
+        fails.append("heal never entered CATCHUP")
+    if run["degraded_bundles"] != run["degraded_modes_entered"]:
+        fails.append(
+            f"flight-recorder bundles {run['degraded_bundles']} != "
+            f"degraded modes entered {run['degraded_modes_entered']}")
+    if run["ledger"]["relists"] < 1:
+        fails.append("heal produced no gap-resync relist")
+    if run["fuzz_violations"]:
+        fails.append("partition-fenced-mutate: "
+                     + "; ".join(run["fuzz_violations"]))
+    return fails
+
+
+def check_budget(run: dict) -> list[str]:
+    if not BENCH_PR16_FILE.exists():
+        return []
+    recorded = json.loads(BENCH_PR16_FILE.read_text())
+    budget = recorded.get("budget", {})
+    ceiling = budget.get("wall_s")
+    if (ceiling is not None
+            and run["claims"] == budget.get("claims")
+            and run["partition_s"] == budget.get("partition_s")
+            and run["wall_s"] > ceiling):
+        return [f"catch-up wall regressed: {run['wall_s']}s > "
+                f"{ceiling}s budget ({BENCH_PR16_FILE.name})"]
+    return []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gate", action="store_true",
+                    help="enforce the PR 16 gates + recorded wall budget")
+    ap.add_argument("--write", action="store_true",
+                    help=f"record the run as {BENCH_PR16_FILE.name}")
+    ap.add_argument("--claims", type=int, default=100)
+    ap.add_argument("--partition", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    run = asyncio.run(catchup_storm(args.claims, args.partition, args.seed))
+    print(json.dumps(run, indent=2, sort_keys=True))
+
+    fails = check_gates(run)
+    if args.gate:
+        fails += check_budget(run)
+    if args.write and not fails:
+        doc = {
+            "bench": "apifaults-catchup-storm",
+            "pr": 16,
+            "reference": run,
+            "gates": {
+                "status_writes_per_claim_max": STATUS_WRITES_PER_CLAIM_MAX,
+                "timer_wake_share_max": TIMER_WAKE_SHARE_MAX,
+            },
+            "budget": {
+                "claims": run["claims"],
+                "partition_s": run["partition_s"],
+                "wall_s": round(WALL_BUDGET_FACTOR * run["wall_s"], 1),
+            },
+        }
+        BENCH_PR16_FILE.write_text(json.dumps(doc, indent=2,
+                                              sort_keys=True) + "\n")
+        print(f"recorded {BENCH_PR16_FILE.name}")
+    for f in fails:
+        print(f"GATE FAIL: {f}", file=sys.stderr)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

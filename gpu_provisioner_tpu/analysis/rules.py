@@ -605,6 +605,73 @@ def check_unsourced_requeue_wait(ctx: RuleContext) -> list[tuple[int, str]]:
     return out
 
 
+# ---------------------------------------------- PL015 unclassified-watch-gap
+
+# Watch/list pump loops, by the names this codebase (and client-go) uses.
+_PL015_NAME_RE = re.compile(r"(^|_)(run|watch|pump|relist|resync)")
+
+# The verbs a pump loop issues against a watch/list surface. A function
+# that never touches one of these is not a pump, whatever its name
+# (providers/operations.py `_run` ticks reconcile state, not a watch).
+_PL015_TOUCH = frozenset({
+    "watch", "__anext__", "try_next", "list", "list_pages", "_stream",
+    "_list_into_queue", "relist", "_relist", "resync", "_resync",
+})
+
+# Broad handlers that would swallow a 410 into the generic retry path.
+_PL015_BROAD = frozenset({
+    "Exception", "BaseException", "ClientError", "APIError",
+})
+
+# Names/attributes whose presence proves the function classifies expired-
+# resourceVersion distinctly: the typed error, or a typed `.expired` /
+# `.gone` predicate on a caught error.
+_PL015_CLASSIFIERS = frozenset({"ResourceExpiredError", "expired", "gone"})
+
+
+def _pl015_handler_names(h: ast.ExceptHandler) -> list[str]:
+    types = (h.type.elts if isinstance(h.type, ast.Tuple)
+             else [h.type] if h.type is not None else [])
+    return [(dotted_name(t) or "").rsplit(".", 1)[-1] for t in types]
+
+
+def check_unclassified_watch_gap(ctx: RuleContext) -> list[tuple[int, str]]:
+    out = []
+    for fn in ctx.functions():
+        if not _PL015_NAME_RE.search(fn.name):
+            continue
+        nodes = list(body_walk(fn))
+        if not any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr in _PL015_TOUCH for n in nodes):
+            continue
+        classified = any(
+            (isinstance(n, ast.Name) and n.id in _PL015_CLASSIFIERS)
+            or (isinstance(n, ast.Attribute)
+                and n.attr in _PL015_CLASSIFIERS)
+            # getattr(e, "expired", False) — the duck-typed predicate probe
+            or (isinstance(n, ast.Constant)
+                and n.value in ("expired", "gone"))
+            for n in nodes)
+        if classified:
+            continue
+        for h in nodes:
+            if (isinstance(h, ast.ExceptHandler)
+                    and any(name in _PL015_BROAD
+                            for name in _pl015_handler_names(h))):
+                out.append((h.lineno, (
+                    "watch/list pump catches broad errors without "
+                    "classifying expired-resourceVersion — a 410 Gone "
+                    "swallowed into the generic retry path reconnects "
+                    "forever against compacted history and the informer "
+                    "cache silently diverges; branch on "
+                    "ResourceExpiredError (or the provider errors' "
+                    ".expired/.gone predicate) and relist (PR 16 "
+                    "watch-gap resync)")))
+                break  # one finding per pump function
+    return out
+
+
 # ----------------------------------------------------------------- catalog
 
 RULES: list[Rule] = [
@@ -667,4 +734,11 @@ RULES: list[Rule] = [
          "— a `# wakes: <source>` annotation or an in-function WakeHub wake "
          "(PR 11 event-driven control plane: the timer is the safety net, "
          "never the undeclared primary)", check_unsourced_requeue_wait),
+    Rule("PL015", "unclassified-watch-gap",
+         frozenset({ROLE_RUNTIME, ROLE_PROVIDERS}),
+         "watch/list pump loops with broad error handlers must branch on "
+         "expired-resourceVersion (ResourceExpiredError / .expired / "
+         ".gone) — a 410 swallowed into generic retry reconnects forever "
+         "and silently diverges the cache (PR 16 watch-gap resync)",
+         check_unclassified_watch_gap),
 ]

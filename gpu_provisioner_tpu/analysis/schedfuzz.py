@@ -286,12 +286,37 @@ def check_stop_before_late_wake(events: list[FuzzEvent]) -> list[Violation]:
     return out
 
 
+def check_partition_fenced_mutate(events: list[FuzzEvent]) -> list[Violation]:
+    """No cloud mutation may land while the APIHealthGovernor holds the
+    incarnation in PARTITIONED — the apiserver is unreachable, so the
+    mutation's outcome could not be recorded and a healed restart would
+    re-create the pool (the duplicate-pool-factory class, PR 16). The
+    governor emits ``api-mode`` on every transition; this replays the
+    mode timeline and flags any ``cloud-mutate`` inside a PARTITIONED
+    window. The runtime guard is the provider's PartitionFencedError
+    raise in ``_fence_check``; this proves it held across interleavings."""
+    mode = "HEALTHY"
+    out: list[Violation] = []
+    for e in events:
+        if e.name == "api-mode":
+            mode = str(e.key)
+        elif e.name == "cloud-mutate" and mode == "PARTITIONED":
+            out.append(Violation(
+                "partition-fenced-mutate", e.seq,
+                f"cloud mutation {e.key} issued while the governor was "
+                f"PARTITIONED — the kube apiserver is unreachable, the "
+                f"outcome cannot be recorded, and a healed incarnation "
+                f"would re-create the pool (duplicate-pool factory)"))
+    return out
+
+
 CHECKERS: dict[str, Callable[[list[FuzzEvent]], list[Violation]]] = {
     "cache-before-deliver": check_cache_before_deliver,
     "stale-timer-requeue": check_stale_timer_requeue,
     "fence-before-mutate": check_fence_before_mutate,
     "meta-before-status": check_meta_before_status,
     "stop-before-late-wake": check_stop_before_late_wake,
+    "partition-fenced-mutate": check_partition_fenced_mutate,
 }
 
 

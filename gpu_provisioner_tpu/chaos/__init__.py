@@ -4,6 +4,9 @@ crash-point schedule the crash-restart recovery suite drives, and the
 node-fault injector that makes Nodes themselves sick (flapping Ready,
 degraded accelerators, silent kubelet death, maintenance waves)."""
 
+from .apifaults import (
+    API_PROFILES, ApiFaultClient, ApiFaultInjector, api_fault_profile,
+)
 from .client import ChaosClient, ChaosClientError, transient_kube
 from .crash import CRASH_POINTS, CrashPoints, SimulatedCrash
 from .nodefaults import (
@@ -17,10 +20,11 @@ from .policy import (
 )
 
 __all__ = [
-    "ACCELERATOR_HEALTHY", "CRASH_POINTS", "ChaosClient", "ChaosClientError",
+    "ACCELERATOR_HEALTHY", "API_PROFILES", "ApiFaultClient",
+    "ApiFaultInjector", "CRASH_POINTS", "ChaosClient", "ChaosClientError",
     "ChaosPolicy", "CrashPoints", "FAULT_KINDS", "FaultRule",
     "MAINTENANCE_SCHEDULED", "NODE_FAULT_PROFILES", "NodeFault",
     "NodeFaultInjector", "PROFILES", "SPOT_PREEMPTED", "SimulatedCrash",
-    "ZoneWindow", "node_fault_profile", "profile", "stockout", "transient",
-    "transient_kube",
+    "ZoneWindow", "api_fault_profile", "node_fault_profile", "profile",
+    "stockout", "transient", "transient_kube",
 ]

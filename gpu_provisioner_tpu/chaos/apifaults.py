@@ -1,0 +1,356 @@
+"""Seeded kube-APISERVER fault injection: brownouts, partitions, watch gaps.
+
+Every chaos profile before PR 16 attacked the cloud side (policy.py) or the
+nodes (nodefaults.py); the apiserver — the one dependency every reconcile
+rides — had no fault model. This module closes that: a seeded
+:class:`ApiFaultInjector` describes fault windows on the kube client's
+verbs and watch streams, and :class:`ApiFaultClient` wires them into the
+envtest client chain (below the informer, so relists and watches feel the
+faults exactly like a real reflector would).
+
+Fault vocabulary:
+
+- **brownout** — latency inflation plus seeded 429-with-Retry-After and
+  503 bursts on every verb during a window.
+- **partition** — a total kube-API outage window: every verb raises, the
+  watch stream goes silent (events land in the store but never reach the
+  consumer — exactly what a dead HTTP stream does).
+- **watch gap** — watch events silently dropped during a window, then a
+  410 Gone / expired-resourceVersion answer at the window's end: the
+  classic compacted-history hole only a relist-and-diff can heal.
+- **catchup storm** — a partition whose heal expires EVERY watch (410
+  regardless of drops) into a full-fleet relist, with throttling pressure
+  during the catch-up.
+
+Determinism matches policy.py: draws hash (seed, decision-key), windows
+anchor at the injector's FIRST consult (the ZoneWindow idiom), so a given
+(profile, seed) replays bit-identically regardless of wall clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from typing import Callable, Optional
+
+from ..runtime.client import (
+    ClientError, ResourceExpiredError, TooManyRequestsError,
+)
+
+
+class ApiFaultInjector:
+    """Seeded schedule of apiserver fault windows.
+
+    All times are seconds relative to the injector's first consult (loop
+    clock). ``brownout_duration=None`` with nonzero rates means the
+    brownout never ends; ``partition_start=None`` means no partition.
+    Observability mirrors ChaosPolicy: ``calls``/``injected`` per-site
+    counters plus ``dropped`` per-kind watch-event counts.
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 latency: float = 0.0,
+                 throttle_rate: float = 0.0,
+                 error_rate: float = 0.0,
+                 retry_after: float = 0.05,
+                 brownout_start: float = 0.0,
+                 brownout_duration: Optional[float] = None,
+                 partition_start: Optional[float] = None,
+                 partition_duration: float = 0.0,
+                 gap_start: Optional[float] = None,
+                 gap_duration: float = 0.0,
+                 heal_410: bool = False):
+        self.seed = seed
+        self.latency = latency
+        self.throttle_rate = throttle_rate
+        self.error_rate = error_rate
+        self.retry_after = retry_after
+        self.brownout_start = brownout_start
+        self.brownout_duration = brownout_duration
+        self.partition_start = partition_start
+        self.partition_duration = partition_duration
+        self.gap_start = gap_start
+        self.gap_duration = gap_duration
+        self.heal_410 = heal_410
+        self._anchor: Optional[float] = None
+        self.calls: dict[str, int] = {}
+        self.injected: dict[str, int] = {}
+        self.dropped: dict[str, int] = {}
+
+    # -- clock / determinism ----------------------------------------------
+
+    def _elapsed(self) -> float:
+        now = asyncio.get_event_loop().time()
+        if self._anchor is None:
+            self._anchor = now
+        return now - self._anchor
+
+    def _draw(self, *key) -> float:
+        h = hashlib.sha256(repr((self.seed,) + key).encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2 ** 64
+
+    @staticmethod
+    def _in(start: Optional[float], duration: Optional[float],
+            el: float) -> bool:
+        if start is None:
+            return False
+        if duration is None:
+            return el >= start
+        return start <= el < start + duration
+
+    # -- window queries ----------------------------------------------------
+
+    def partition_active(self) -> bool:
+        return self._in(self.partition_start, self.partition_duration,
+                        self._elapsed())
+
+    def brownout_active(self) -> bool:
+        if not (self.latency or self.throttle_rate or self.error_rate):
+            return False
+        return self._in(self.brownout_start, self.brownout_duration,
+                        self._elapsed())
+
+    def gap_active(self) -> bool:
+        """True while the watch stream is losing events: an explicit gap
+        window, or a partition (a dead stream drops everything)."""
+        el = self._elapsed()
+        return (self._in(self.gap_start, self.gap_duration, el)
+                or self._in(self.partition_start, self.partition_duration,
+                            el))
+
+    def affects_watch(self) -> bool:
+        return self.gap_start is not None or self.partition_start is not None
+
+    def _count(self, table: dict[str, int], key: str) -> None:
+        table[key] = table.get(key, 0) + 1
+
+    # -- verb path ---------------------------------------------------------
+
+    async def before_verb(self, verb: str) -> None:
+        """Consulted by :class:`ApiFaultClient` before delegating a verb.
+        Raises the injected fault, or returns after any injected latency."""
+        self._count(self.calls, verb)
+        n = self.calls[verb]
+        if self.partition_active():
+            self._count(self.injected, f"partition:{verb}")
+            raise ClientError(
+                f"{verb}: apiserver unreachable (injected partition)")
+        if not self.brownout_active():
+            return
+        if self.latency:
+            await asyncio.sleep(
+                self.latency * (0.5 + self._draw("latency", verb, n)))
+        if (self.throttle_rate
+                and self._draw("throttle", verb, n) < self.throttle_rate):
+            self._count(self.injected, f"throttle:{verb}")
+            raise TooManyRequestsError(
+                f"{verb}: HTTP 429 (injected brownout throttle)",
+                retry_after=self.retry_after)
+        if (self.error_rate
+                and self._draw("error", verb, n) < self.error_rate):
+            self._count(self.injected, f"error:{verb}")
+            raise ClientError(f"{verb}: HTTP 503 (injected brownout)")
+
+
+class _FaultWatch:
+    """Watch wrapper that silently drops events during a gap/partition
+    window, then answers 410 Gone once the window closes — the compacted
+    watch-history hole the informer's gap resync exists to heal. The 410
+    fires even on a quiet stream (bounded poll while windows are armed), so
+    the heal never waits for a fresh event that may not come."""
+
+    _POLL = 0.02
+
+    def __init__(self, inner, faults: ApiFaultInjector, kind: str):
+        self._inner = inner
+        self._f = faults
+        self._kind = kind
+        self._saw_gap = False
+        self._dropped = 0
+
+    def __aiter__(self):
+        return self
+
+    def _heal_check(self) -> None:
+        """Raise ResourceExpiredError exactly once per closed gap window
+        that lost events (always, under heal_410 — the catchup storm)."""
+        if self._f.gap_active():
+            self._saw_gap = True
+            return
+        if not self._saw_gap:
+            return
+        self._saw_gap = False
+        dropped, self._dropped = self._dropped, 0
+        if dropped or self._f.heal_410:
+            raise ResourceExpiredError(
+                f"{self._kind} watch: HTTP 410 Gone — resourceVersion "
+                f"expired ({dropped} events compacted during injected gap)")
+
+    def _drop(self, ev) -> None:
+        del ev
+        self._saw_gap = True
+        self._dropped += 1
+        self._f._count(self._f.dropped, self._kind)
+
+    async def __anext__(self):
+        if not self._f.affects_watch():
+            return await self._inner.__anext__()
+        while True:
+            self._heal_check()
+            gapped = self._f.gap_active()
+            try:
+                ev = await asyncio.wait_for(self._inner.__anext__(),
+                                            self._POLL)
+            except asyncio.TimeoutError:
+                continue
+            # an event that raced the window edge is judged by the LATER of
+            # the two looks — losing one extra event to the gap is exactly
+            # the ambiguity a real stream teardown has
+            if gapped or self._f.gap_active():
+                self._drop(ev)
+                continue
+            return ev
+
+    def try_next(self):
+        if not self._f.affects_watch():
+            return self._inner.try_next()
+        self._heal_check()
+        while True:
+            ev = self._inner.try_next()
+            if ev is None:
+                return None
+            if self._f.gap_active():
+                self._drop(ev)
+                continue
+            return ev
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class ApiFaultClient:
+    """Delegating kube-client wrapper driven by an :class:`ApiFaultInjector`.
+
+    Sits below the informer in the envtest chain (raw → ChaosClient →
+    **ApiFaultClient** → GovernedClient → CachedListClient) so informer
+    relists, controller reads and status writes all feel the same weather —
+    and watch streams degrade exactly like real reflector connections."""
+
+    def __init__(self, inner, faults: ApiFaultInjector):
+        self.inner = inner
+        self.faults = faults
+
+    @property
+    def store(self):
+        return self.inner.store
+
+    async def get(self, cls, name, namespace=""):
+        await self.faults.before_verb("get")
+        return await self.inner.get(cls, name, namespace)
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        await self.faults.before_verb("list")
+        return await self.inner.list(cls, labels, namespace, index)
+
+    async def create(self, obj):
+        await self.faults.before_verb("create")
+        return await self.inner.create(obj)
+
+    async def update(self, obj):
+        await self.faults.before_verb("update")
+        return await self.inner.update(obj)
+
+    async def update_status(self, obj):
+        await self.faults.before_verb("update_status")
+        return await self.inner.update_status(obj)
+
+    async def delete(self, cls, name, namespace=""):
+        await self.faults.before_verb("delete")
+        return await self.inner.delete(cls, name, namespace)
+
+    async def evict(self, name, namespace="", uid=""):
+        await self.faults.before_verb("evict")
+        return await self.inner.evict(name, namespace, uid=uid)
+
+    def watch(self, cls):
+        return _FaultWatch(self.inner.watch(cls), self.faults,
+                           getattr(cls, "KIND", cls.__name__))
+
+    def add_index(self, cls, name, key_fn):
+        if hasattr(self.inner, "add_index"):
+            self.inner.add_index(cls, name, key_fn)
+
+
+# ---------------------------------------------------------------------------
+# Named profiles (the policy.py PROFILES idiom): soaks select by name +
+# seed; keyword overrides let a soak stretch a window (the 30s partition)
+# without forking the profile.
+
+API_PROFILES: dict[str, Callable[..., ApiFaultInjector]] = {}
+
+
+def _register(name: str):
+    def deco(fn):
+        API_PROFILES[name] = fn
+        return fn
+    return deco
+
+
+def api_fault_profile(name: str, seed: int = 0, **overrides) -> ApiFaultInjector:
+    """Build a named apiserver-fault profile with ``seed``. Unknown names
+    raise with the known-profile list (mirrors chaos.profile)."""
+    try:
+        build = API_PROFILES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown API fault profile {name!r}; known: "
+            f"{sorted(API_PROFILES)}") from None
+    return build(seed, **overrides)
+
+
+@_register("apiserver_brownout")
+def _apiserver_brownout(seed: int, **kw) -> ApiFaultInjector:
+    """Latency inflation + 429/503 bursts with Retry-After: the apiserver
+    is up but drowning. Drives HEALTHY→BROWNOUT and the AIMD backoff."""
+    kw.setdefault("latency", 0.005)
+    kw.setdefault("throttle_rate", 0.2)
+    kw.setdefault("error_rate", 0.1)
+    kw.setdefault("retry_after", 0.05)
+    kw.setdefault("brownout_start", 0.1)
+    kw.setdefault("brownout_duration", 2.0)
+    return ApiFaultInjector(seed, **kw)
+
+
+@_register("apiserver_partition")
+def _apiserver_partition(seed: int, **kw) -> ApiFaultInjector:
+    """Total kube-API outage window: every verb fails, the watch stream
+    drops everything, and the heal answers 410 — partition-fencing plus
+    gap resync must carry the fleet through."""
+    kw.setdefault("partition_start", 0.3)
+    kw.setdefault("partition_duration", 1.0)
+    return ApiFaultInjector(seed, **kw)
+
+
+@_register("watch_gap")
+def _watch_gap(seed: int, **kw) -> ApiFaultInjector:
+    """Silently dropped watch events, then a 410 Gone answer: verbs stay
+    healthy, only the stream lies. The informer's diff-based resync must
+    synthesize the missed events."""
+    kw.setdefault("gap_start", 0.1)
+    kw.setdefault("gap_duration", 0.5)
+    return ApiFaultInjector(seed, **kw)
+
+
+@_register("catchup_storm")
+def _catchup_storm(seed: int, **kw) -> ApiFaultInjector:
+    """Partition heal into a full-fleet relist: every watch expires at the
+    heal (410 regardless of drops) while the recovering apiserver still
+    throttles — the storm the CATCHUP mode and status-shedding absorb."""
+    kw.setdefault("partition_start", 0.3)
+    kw.setdefault("partition_duration", 0.8)
+    kw.setdefault("heal_410", True)
+    kw.setdefault("throttle_rate", 0.15)
+    kw.setdefault("retry_after", 0.05)
+    kw.setdefault("brownout_start", 1.1)
+    kw.setdefault("brownout_duration", 1.5)
+    return ApiFaultInjector(seed, **kw)

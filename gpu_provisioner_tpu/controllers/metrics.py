@@ -317,6 +317,32 @@ FLIGHT_RECORDER_BUNDLES = _get_or_create(
     "Diagnostic bundles snapshotted by anomaly triggers (cumulative, "
     "sampled; repeats of a trigger are deduped, not bundled).", [])
 
+# ------------------------------------------------------- apiserver health
+# The degraded-mode control plane (runtime/apihealth.py): mode machine plus
+# the watch-gap/relist/shed ledger, sampled at scrape like WAKES.
+
+DEGRADED_MODE = _get_or_create(
+    Gauge, "tpu_provisioner_degraded_mode",
+    "APIHealthGovernor degraded-mode state: 0 HEALTHY, 1 BROWNOUT, "
+    "2 PARTITIONED, 3 CATCHUP (the worst across live governors).", [])
+
+WATCH_GAPS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_watch_gaps_total",
+    "Watch streams that answered 410 Gone / expired resourceVersion "
+    "(delta-fed from the runtime apihealth ledger).", [])
+
+RELISTS_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_relists_total",
+    "Gap-resync relists completed (diff synthesized through the informer "
+    "relays; delta-fed from the runtime apihealth ledger).", [])
+
+API_SHED_TOTAL = _get_or_create(
+    Counter, "tpu_provisioner_api_shed_total",
+    "Work deferred by overload shedding: paced reconcile/write waits plus "
+    "widened status-batch windows (delta-fed).", [])
+
+_apihealth_seen: dict[str, int] = {}
+
 # ---------------------------------------------------------- serving engine
 # models/engine.py stats() bridged into gauges via the fleet ENGINES
 # registry (weak values — a dead engine leaves the scrape). The autoscaler
@@ -474,6 +500,18 @@ def update_runtime_gauges(manager) -> None:
         if delta > 0:
             SLO_VIOLATIONS_TOTAL.labels(objective).inc(delta)
             _slo_violations_seen[objective] = st["bad"]
+    from ..runtime import apihealth as _apihealth
+    _LEDGER_COUNTERS = (("watch_gaps", WATCH_GAPS_TOTAL),
+                        ("relists", RELISTS_TOTAL),
+                        ("shed", API_SHED_TOTAL))
+    for key, counter in _LEDGER_COUNTERS:
+        n = _apihealth.APIHEALTH.get(key, 0)
+        delta = n - _apihealth_seen.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+            _apihealth_seen[key] = n
+    DEGRADED_MODE.set(max(
+        (g.mode_value() for g in list(_apihealth.GOVERNORS)), default=0))
     events = bundles = 0
     for rec in list(_flightrecorder.RECORDERS):
         events += rec.events_recorded

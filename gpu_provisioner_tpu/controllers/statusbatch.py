@@ -50,7 +50,7 @@ import logging
 from typing import Optional
 
 from ..apis.karpenter import NodeClaim
-from ..runtime import NotFoundError, probes
+from ..runtime import NotFoundError, apihealth, probes
 from ..runtime.client import Client, ConflictError, patch_retry
 from ..runtime.wakehub import SOURCE_STATUS_FLUSH
 
@@ -137,6 +137,12 @@ class StatusWriteBatcher:
         self.fence = fence
         self.tracer = tracer
         self.wakehub = wakehub
+        # APIHealthGovernor, assigned post-construction like the fence.
+        # Status writes shed FIRST under apiserver distress: the window
+        # widens by the governor's factor (more coalescing, fewer writes)
+        # and each write is paced — deferred, never dropped.
+        self.governor = None
+        self.shed_windows = 0
         self._pending: dict[str, NodeClaim] = {}
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -206,8 +212,18 @@ class StatusWriteBatcher:
 
     def _next_window(self) -> float:
         """Base window, stretched to the last flush's duration (capped at
-        ``max_window``) — flush cost is the load signal."""
-        return max(self.window, min(self._last_flush_s, self.max_window))
+        ``max_window``) — flush cost is the load signal. Under a degraded
+        apiserver the governor's factor widens it further: status is the
+        least-durable write class (always re-derivable from a reconcile),
+        so it sheds before meta or cloud mutations slow down at all."""
+        base = max(self.window, min(self._last_flush_s, self.max_window))
+        if self.governor is not None:
+            factor = self.governor.status_window_factor()
+            if factor > 1.0:
+                self.shed_windows += 1
+                apihealth.note_shed()
+                return min(base * factor, self.max_window * factor)
+        return base
 
     async def _run(self) -> None:
         while True:
@@ -259,6 +275,10 @@ class StatusWriteBatcher:
         async def one(nc: NodeClaim) -> None:
             async with sem:
                 try:
+                    if self.governor is not None:
+                        # paced, never dropped: the meta+status pair rides
+                        # the same AIMD limit the reconcile workers do
+                        await self.governor.pace()
                     changed = await write_claim_patches(self.client, nc,
                                                         tracer=self.tracer)
                 except NotFoundError:

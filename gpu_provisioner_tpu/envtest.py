@@ -127,6 +127,20 @@ class EnvtestOptions:
     # provider/controllers. env.client stays raw so test assertions and
     # helpers never see injected faults.
     chaos: object = None
+    # API-fault injection (chaos.ApiFaultInjector or a profile built by
+    # chaos.api_fault_profile(name, seed)): wraps the kube client handed to
+    # the provider/controllers/informers with brownout latency, seeded
+    # 429/503 bursts, partition windows, and watch gaps that heal into a
+    # 410 Gone. Layered OUTSIDE ChaosClient and INSIDE the governor, so
+    # injected weather is felt by informer relists and classified by the
+    # APIHealthGovernor exactly like real apiserver weather would be.
+    # env.client stays raw so assertions/helpers never see faults.
+    api_faults: object = None
+    # Adaptive overload shedding (runtime/apihealth.py), ON by default like
+    # tracing/fleetscope: the governor is passive (no background tasks) and
+    # its pace() is a no-op fast path while HEALTHY, so healthy runs pay
+    # nothing. Off, env.governor is None and nothing is paced or fenced.
+    api_governor: bool = True
     # Runtime hardening knobs (runtime/controller.py): per-reconcile
     # deadline and per-item retry bound for the per-object controllers.
     reconcile_timeout: Optional[float] = None
@@ -221,6 +235,21 @@ class Env:
         if self.chaos is not None:
             from .chaos import ChaosClient
             kube = ChaosClient(self.client, self.chaos)
+        # API-fault layer: apiserver weather (brownout/partition/watch-gap)
+        # injected OUTSIDE kube chaos so both compose, and INSIDE the
+        # governor so every injected 429/503/timeout classifies into it.
+        self.api_faults = self.opts.api_faults
+        if self.api_faults is not None:
+            from .chaos import ApiFaultClient
+            kube = ApiFaultClient(kube, self.api_faults)
+        # Overload governor: classifies every verb outcome (AIMD rate +
+        # degraded-mode state machine); consumers (workers, status batcher,
+        # provider fence, informers) are handed the SAME instance below.
+        self.governor = None
+        if self.opts.api_governor:
+            from .runtime.apihealth import APIHealthGovernor, GovernedClient
+            self.governor = APIHealthGovernor()
+            kube = GovernedClient(kube, self.governor)
         self.informers = None
         if self.opts.use_informer:
             from .runtime.informer import CachedListClient
@@ -353,6 +382,21 @@ class Env:
         # real operator wires Manager(kube) identically). ChaosClient
         # passes watch() through, so kube chaos still never gates events.
         self.manager = Manager(kube).register(*controllers)
+        # Governor fan-out, assigned post-construction like the fence and
+        # the wakehub: per-object workers pace admission, the status batcher
+        # widens its window (status writes shed FIRST), the provider fences
+        # cloud mutations while PARTITIONED, and informers report watch
+        # gaps. Singletons (gc/recovery) have no worker admission seam.
+        if self.governor is not None:
+            for c in controllers:
+                if hasattr(c, "governor"):
+                    c.governor = self.governor
+            if self.status_batcher is not None:
+                self.status_batcher.governor = self.governor
+            self.provider.api_governor = self.governor
+            if self.informers is not None:
+                for inf in self.informers._informers.values():
+                    inf.governor = self.governor
         if self.flight_recorder is not None:
             from .observability.flightrecorder import wire_default_sources
             wire_default_sources(self.flight_recorder,
@@ -370,6 +414,16 @@ class Env:
         with :meth:`_detach_observers` on every exit path — a torn-down
         Env's recorder must not keep seeing other Envs' events through the
         module-global seams."""
+        if self.governor is not None:
+            # transport 429s (pacing, not failure) feed the AIMD governor;
+            # bound method so _detach_observers can remove exactly it
+            from .transport import add_throttle_listener
+            add_throttle_listener(self._on_throttled)
+            if self.flight_recorder is not None:
+                # one bundle per degraded-mode ENTRY (flaps suppressed by
+                # the recorder's trigger dedup)
+                self.governor.add_degraded_listener(
+                    self.flight_recorder.degraded_entered)
         if self.flight_recorder is None:
             return
         from .runtime import probes
@@ -379,7 +433,17 @@ class Env:
         if self.stall is not None:
             self.stall.on_stall = self.flight_recorder.stall
 
+    def _on_throttled(self, name: str, retry_after: float) -> None:
+        """transport.add_throttle_listener adapter → governor AIMD."""
+        self.governor.note_throttle(retry_after)
+
     def _detach_observers(self) -> None:
+        if self.governor is not None:
+            from .transport import remove_throttle_listener
+            remove_throttle_listener(self._on_throttled)
+            if self.flight_recorder is not None:
+                self.governor.remove_degraded_listener(
+                    self.flight_recorder.degraded_entered)
         if self.flight_recorder is None:
             return
         from .runtime import probes

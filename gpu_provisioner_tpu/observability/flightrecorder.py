@@ -65,6 +65,8 @@ RECORDED_EVENTS = frozenset({
     "repair-success",      # a repaired node came back
     "recovery-adopt",      # restart recovery adopted pre-existing capacity
     "placement-verdict",   # candidate walk decided (chosen/stockout/...)
+    "api-mode",            # APIHealthGovernor mode transition (all of them)
+    "degraded-mode",       # governor ENTERED a non-HEALTHY mode (triggers)
 })
 
 # Probe event → trigger kind. These snapshot a bundle *in addition to*
@@ -74,6 +76,10 @@ TRIGGER_EVENTS = {
     "breaker-open": "breaker-trip",
     "repair-breaker-trip": "repair-breaker-trip",
     "recovery-adopt": "recovery-adoption",
+    # one bundle per degraded-mode ENTERED (keyed by mode name, so a
+    # flapping apiserver can't thrash the disk — re-entries of the same
+    # mode are counted in triggers_suppressed)
+    "degraded-mode": "degraded-mode",
 }
 
 
@@ -148,6 +154,12 @@ class FlightRecorder:
     # calls listeners directly and the recorder adapts here.
     def breaker_opened(self, name: str, **info) -> None:
         self.probe("breaker-open", name, **info)
+
+    # Governor-listener signature (apihealth.add_degraded_listener): fired
+    # on entry into any non-HEALTHY mode. Routed through probe() so the
+    # entry lands in the ring AND snapshots a bundle via TRIGGER_EVENTS.
+    def degraded_entered(self, mode: str, **info) -> None:
+        self.probe("degraded-mode", mode, **info)
 
     def slo_fast_burn(self, tracker) -> None:
         """FleetAggregator.on_fast_burn adapter."""

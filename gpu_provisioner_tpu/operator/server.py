@@ -1,7 +1,8 @@
 """Metrics + health-probe HTTP servers (V9: operator.go:157-224).
 
 Metrics on :8080 (/metrics, Prometheus text format), probes on :8081
-(/healthz always-ok once the process is up; /readyz only after the manager's
+(/healthz 200 once the process is up — the body reports the
+APIHealthGovernor's degraded mode when not HEALTHY; /readyz only after the manager's
 watch caches started and required kinds are registered — the analog of the
 reference's cache-sync + NodeClaim-CRD-presence readyz, operator.go:207-224).
 pprof analog behind --enable-profiling: /debug/tasks dumps live asyncio tasks
@@ -153,6 +154,17 @@ def build_apps(manager: Manager, enable_profiling: bool = False,
     health = web.Application()
 
     async def healthz(_req):
+        # Liveness stays 200 even degraded — restarting this process cannot
+        # heal a browned-out/partitioned apiserver, and a kubelet kill loop
+        # would only add catch-up load. The body carries the worst live
+        # governor's degraded-mode line for humans and probes that look.
+        from ..runtime import apihealth
+        worst = None
+        for g in list(apihealth.GOVERNORS):
+            if worst is None or g.mode_value() > worst.mode_value():
+                worst = g
+        if worst is not None and worst.mode() != apihealth.HEALTHY:
+            return web.Response(text=worst.healthz_line())
         return web.Response(text="ok")
 
     async def readyz(_req):

@@ -165,3 +165,12 @@ class APIError(Exception):
     @property
     def exhausted(self) -> bool:
         return self.code == 429
+
+    @property
+    def expired(self) -> bool:
+        """410 Gone: an expired page token / compacted resource history.
+        Retrying the SAME request can never succeed — callers restart the
+        list from scratch (the cloud-side analog of the kube watch's
+        expired-resourceVersion; provlint PL015 pins the distinct branch
+        on both sides)."""
+        return self.code == 410

@@ -48,6 +48,7 @@ from ..errors import (
     REASON_STOCKOUT_SUPPRESSED, REASON_UNRESOLVABLE_SHAPE,
 )
 from ..runtime import probes
+from ..runtime.apihealth import PartitionFencedError
 from ..runtime.client import Client, patch_retry
 from ..runtime.wakehub import SOURCE_STOCKOUT
 from ..scheduling import Requirements
@@ -238,6 +239,11 @@ class InstanceProvider:
         # WakeHub (runtime/wakehub.py), assigned by the boot path / envtest
         # like the fence: stockout parking arms memo-expiry wakes on it.
         self.wakehub = None
+        # APIHealthGovernor (runtime/apihealth.py), assigned like the fence:
+        # while the kube apiserver is PARTITIONED no cloud mutation may
+        # proceed — a create whose outcome can't be recorded in kube is a
+        # duplicate-pool factory once the partition heals.
+        self.api_governor = None
         # Placement engine (providers/placement.py): preference-ordered
         # zone × shape × tier candidates, per-zone stockout memo, spot
         # demotion hysteresis. The default single-zone/no-tier config yields
@@ -680,6 +686,16 @@ class InstanceProvider:
         # then drop on dequeue.
         if self.fence is not None:
             self.fence.check()
+        # Partition fence: while the governor reports the kube apiserver
+        # PARTITIONED, refuse cloud mutations outright (same generic error
+        # path — rate-limited requeue; the claim retries once the governor
+        # leaves PARTITIONED). The schedfuzz partition-fenced-mutate checker
+        # asserts no cloud-mutate ever lands inside that mode.
+        if (self.api_governor is not None
+                and self.api_governor.partition_fenced()):
+            raise PartitionFencedError(
+                "cloud mutation refused: kube apiserver partitioned — "
+                "outcome could not be recorded")
         # emitted even with no fence wired (the check ran and passed) —
         # schedfuzz's fence-before-mutate contract observes the discipline,
         # not the token

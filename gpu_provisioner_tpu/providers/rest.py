@@ -74,6 +74,13 @@ class _AuthedREST:
             # into CreateError → rate-limited requeue, so a down cloud API
             # costs one local exception per reconcile, not a retry storm.
             raise APIError(str(e), code=503) from e
+        if resp.status_code == 410:
+            # expired page token / compacted history: deliberately NOT in
+            # RETRYABLE_STATUS (retrying the same request can never
+            # succeed) and typed via APIError.expired so list consumers
+            # restart from scratch instead of riding the backoff ladder —
+            # the cloud-side mirror of the kube watch 410 path (PL015)
+            raise APIError(f"gone (expired): {resp.text[:512]}", code=410)
         if resp.status_code >= 400:
             raise APIError(resp.text[:512], code=resp.status_code)
         return resp.json() if resp.content else {}

@@ -14,7 +14,8 @@ environment, so the load-bearing subset is rebuilt natively on asyncio:
 
 from .client import (  # noqa: F401
     AlreadyExistsError, Client, ConflictError, EvictionBlockedError,
-    InMemoryClient, NotFoundError,
+    InMemoryClient, NotFoundError, ResourceExpiredError,
+    TooManyRequestsError,
 )
 from .controller import (  # noqa: F401
     Controller, Manager, Reconciler, Request, Result, Singleton,

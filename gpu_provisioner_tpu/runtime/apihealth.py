@@ -1,0 +1,381 @@
+"""APIHealthGovernor: adaptive apiserver overload shedding + degraded modes.
+
+Every reconcile in the tree rides the kube apiserver; PRs 1-15 hardened the
+control plane against cloud errors, crashes, node faults and stockouts, but
+apiserver brownouts/partitions had no model at all. This module is the
+runtime half of PR 16's answer:
+
+- **Signals in**: 429 Retry-After (throttling), 5xx/timeouts (failure),
+  successes, watch gaps (410 Gone). They arrive from three seams: the
+  :class:`GovernedClient` wrapper classifies every kube verb outcome, the
+  transport's throttle-listener seam forwards Retry-After from the HTTP
+  layer, and the informer reports watch gaps.
+- **AIMD limit out**: an additive-increase / multiplicative-decrease rate
+  the workqueues consume via :meth:`pace` before each reconcile and the
+  status batcher consumes via :meth:`status_window_factor` (status writes
+  shed FIRST — the batcher widens its coalescing window; meta and
+  cloud-mutation writes are paced, never dropped). In HEALTHY mode
+  :meth:`pace` is a no-op fast path — no overload, no shed — so the 10k
+  megawave bench pays one attribute check per reconcile.
+- **Degraded-mode state machine**: HEALTHY→BROWNOUT→PARTITIONED→CATCHUP,
+  exposed at ``/healthz``, as the ``tpu_provisioner_degraded_mode`` gauge,
+  and to the flight recorder (one bundle per degraded entry) through the
+  degraded-listener seam. Transitions emit the ``api-mode`` probe so the
+  schedfuzz ``partition-fenced-mutate`` checker can serialize them against
+  ``cloud-mutate`` events.
+
+Layering: runtime code — no prometheus, no observability imports. Counters
+accumulate in the module-level :data:`APIHEALTH` ledger (the wakehub.WAKES
+idiom) and live governors register in :data:`GOVERNORS`; both are sampled
+delta-style by ``controllers/metrics.py`` at scrape time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import weakref
+from typing import Callable, Optional
+
+from . import probes
+from .client import (
+    AlreadyExistsError, ClientError, ConflictError, EvictionBlockedError,
+    NotFoundError, ResourceExpiredError, TooManyRequestsError,
+)
+
+# Mode names, in gauge-value order (tpu_provisioner_degraded_mode exports
+# the ordinal: 0 healthy, 1 brownout, 2 partitioned, 3 catchup).
+HEALTHY = "HEALTHY"
+BROWNOUT = "BROWNOUT"
+PARTITIONED = "PARTITIONED"
+CATCHUP = "CATCHUP"
+MODE_VALUES = {HEALTHY: 0, BROWNOUT: 1, PARTITIONED: 2, CATCHUP: 3}
+
+# Cumulative event ledger, exported counter-by-delta at scrape time
+# (tpu_provisioner_watch_gaps_total / _relists_total / _api_shed_total).
+APIHEALTH: dict[str, int] = {"watch_gaps": 0, "relists": 0, "shed": 0}
+
+# Live governors, for gauge sampling (the flightrecorder.RECORDERS idiom).
+GOVERNORS: "weakref.WeakSet[APIHealthGovernor]" = weakref.WeakSet()
+
+
+def note_watch_gap() -> None:
+    """A watch stream answered 410 Gone / expired-resourceVersion."""
+    APIHEALTH["watch_gaps"] += 1
+
+
+def note_relist() -> None:
+    """A gap-resync relist completed and its diff was synthesized."""
+    APIHEALTH["relists"] += 1
+
+
+def note_shed() -> None:
+    """The governor deferred work: a paced wait or a widened status window."""
+    APIHEALTH["shed"] += 1
+
+
+def _default_clock() -> float:
+    """Loop time on the loop; monotonic off it. Governors are read from
+    sync contexts too (metrics scrape sampling GOVERNORS) — mode decay must
+    not require a running event loop."""
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()
+
+
+class PartitionFencedError(Exception):
+    """A cloud mutation was refused because the apiserver is partitioned.
+
+    While the control plane cannot write to the kube API it must not mutate
+    the cloud either: a create whose outcome can't be recorded is a
+    duplicate-pool factory the moment the partition heals. The provider's
+    fence check raises this; the reconcile error path requeues with backoff
+    and the claim retries once the governor leaves PARTITIONED."""
+
+
+class APIHealthGovernor:
+    """Folds apiserver health signals into an AIMD pace and a mode machine.
+
+    Single-event-loop discipline (no awaits between check and mutate in the
+    signal paths), so no lock. The mode machine is evaluated lazily — every
+    signal, pace and read calls :meth:`_decay` — so it needs no background
+    task and the envtest leak gate never sees it.
+    """
+
+    def __init__(self, *, rate_max: float = 256.0, rate_min: float = 2.0,
+                 increase: float = 4.0, decrease: float = 0.5,
+                 partition_threshold: int = 5, brownout_hold: float = 2.0,
+                 catchup_hold: float = 2.0, pause_cap: float = 5.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.rate_max = rate_max
+        self.rate_min = rate_min
+        self.increase = increase
+        self.decrease = decrease
+        self.partition_threshold = partition_threshold
+        self.brownout_hold = brownout_hold
+        self.catchup_hold = catchup_hold
+        self.pause_cap = pause_cap
+        self._clock = clock or _default_clock
+        self._mode = HEALTHY
+        self._rate = rate_max
+        self._tokens = rate_max
+        self._last_refill: Optional[float] = None
+        self._pause_until = 0.0
+        self._consec_failures = 0
+        self._last_bad = float("-inf")
+        self._entered_at = float("-inf")
+        self._listeners: list = []
+        # observability (sampled by controllers/metrics.py and /healthz)
+        self.throttles_total = 0
+        self.failures_total = 0
+        self.entries_total: dict[str, int] = {}
+        GOVERNORS.add(self)
+
+    # -- mode machine ------------------------------------------------------
+
+    def mode(self) -> str:
+        self._decay()
+        return self._mode
+
+    def mode_value(self) -> int:
+        return MODE_VALUES[self.mode()]
+
+    def partition_fenced(self) -> bool:
+        """True while cloud mutations must not proceed (see
+        :class:`PartitionFencedError`)."""
+        return self.mode() == PARTITIONED
+
+    def add_degraded_listener(self, fn) -> None:
+        """Register ``fn(mode, **info)``, fired on entry into any
+        non-HEALTHY mode (idempotent). The flight recorder's degraded-mode
+        trigger attaches here — armed from outside (envtest / operator
+        main) exactly like transport breaker listeners."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_degraded_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _set_mode(self, mode: str, reason: str) -> None:
+        if mode == self._mode:
+            return
+        prev, self._mode = self._mode, mode
+        self._entered_at = self._clock()
+        self.entries_total[mode] = self.entries_total.get(mode, 0) + 1
+        if mode == HEALTHY:
+            # full recovery: restore the uncapped pace immediately — the
+            # additive ramp is for CATCHUP, not for steady state
+            self._rate = self.rate_max
+            self._tokens = self.rate_max
+        probes.emit("api-mode", mode, prev=prev, reason=reason)
+        if mode != HEALTHY:
+            for fn in list(self._listeners):
+                try:
+                    fn(mode, prev=prev, reason=reason,
+                       failures=self._consec_failures,
+                       rate=round(self._rate, 1))
+                except Exception:  # noqa: BLE001 — observability seam
+                    pass
+
+    def _decay(self) -> None:
+        now = self._clock()
+        if self._mode == BROWNOUT and now - self._last_bad >= self.brownout_hold:
+            self._set_mode(HEALTHY, "brownout drained")
+        elif (self._mode == CATCHUP
+                and now - self._last_bad >= self.catchup_hold
+                and now - self._entered_at >= self.catchup_hold):
+            self._set_mode(HEALTHY, "catchup drained")
+
+    # -- signals -----------------------------------------------------------
+
+    def note_success(self) -> None:
+        self._consec_failures = 0
+        if self._mode == PARTITIONED:
+            self._set_mode(CATCHUP, "apiserver answered")
+        elif self._mode == CATCHUP:
+            # additive increase: recover pace gradually through the storm
+            self._rate = min(self.rate_max, self._rate + self.increase)
+        self._decay()
+
+    def note_throttle(self, retry_after: float = 0.0) -> None:
+        """A 429: the apiserver is alive and saying slow down."""
+        now = self._clock()
+        self.throttles_total += 1
+        self._last_bad = now
+        self._consec_failures = 0          # an answer, not an outage
+        self._rate = max(self.rate_min, self._rate * self.decrease)
+        if retry_after > 0:
+            self._pause_until = max(
+                self._pause_until, now + min(retry_after, self.pause_cap))
+        if self._mode == HEALTHY:
+            self._set_mode(BROWNOUT, "throttled")
+        elif self._mode == PARTITIONED:
+            self._set_mode(CATCHUP, "apiserver answered (throttling)")
+
+    def note_failure(self) -> None:
+        """A 5xx / timeout / unreachable apiserver."""
+        self.failures_total += 1
+        self._last_bad = self._clock()
+        self._consec_failures += 1
+        self._rate = max(self.rate_min, self._rate * self.decrease)
+        if self._consec_failures >= self.partition_threshold:
+            self._set_mode(PARTITIONED, "consecutive failures")
+        elif self._mode == HEALTHY:
+            self._set_mode(BROWNOUT, "apiserver failure")
+        self._decay()
+
+    def note_watch_gap(self) -> None:
+        """A watch expired (410) — brownout-grade evidence by itself."""
+        self._last_bad = self._clock()
+        if self._mode == HEALTHY:
+            self._set_mode(BROWNOUT, "watch gap")
+
+    # -- consumption -------------------------------------------------------
+
+    async def pace(self, cost: float = 1.0) -> None:
+        """Wait until the AIMD limit admits one unit of apiserver-bound
+        work. No-op in HEALTHY mode: shedding is for overload, steady state
+        pays one mode check."""
+        while True:
+            self._decay()
+            now = self._clock()
+            if self._mode == HEALTHY and now >= self._pause_until:
+                return
+            if now < self._pause_until:
+                note_shed()
+                await asyncio.sleep(self._pause_until - now)
+                continue
+            if self._last_refill is None:
+                self._last_refill = now
+            cap = max(self._rate, 1.0)
+            self._tokens = min(
+                cap, self._tokens + (now - self._last_refill) * self._rate)
+            self._last_refill = now
+            if self._tokens >= cost:
+                self._tokens -= cost
+                return
+            note_shed()
+            await asyncio.sleep(
+                min((cost - self._tokens) / max(self._rate, 0.001), 1.0))
+
+    def status_window_factor(self) -> float:
+        """Multiplier for the status batcher's coalescing window: status
+        writes shed first. 1.0 when healthy; the batcher counts a shed per
+        widened window."""
+        return {HEALTHY: 1.0, BROWNOUT: 4.0,
+                PARTITIONED: 8.0, CATCHUP: 4.0}[self.mode()]
+
+    def healthz_line(self) -> str:
+        m = self.mode()
+        if m == HEALTHY:
+            return "ok"
+        return (f"degraded mode={m} rate={self._rate:.0f}/s "
+                f"failures={self._consec_failures}")
+
+
+class GovernedClient:
+    """Delegating kube-client wrapper that classifies every verb outcome
+    into governor signals. Classification only — pacing is consumed at the
+    workqueue/batcher layer, not per verb, so a single reconcile's handful
+    of reads doesn't pay the token bucket five times.
+
+    Semantic answers (404/409/412-class, eviction 429, 410) count as
+    *success*: the apiserver did its job. Only throttling and server-side
+    failure move the AIMD limit.
+    """
+
+    _SEMANTIC = (NotFoundError, ConflictError, AlreadyExistsError,
+                 EvictionBlockedError, ResourceExpiredError)
+
+    def __init__(self, inner, governor: APIHealthGovernor):
+        self.inner = inner
+        self.governor = governor
+
+    @property
+    def store(self):
+        return self.inner.store
+
+    def _ok(self):
+        self.governor.note_success()
+
+    def _classify(self, e: BaseException) -> None:
+        if isinstance(e, TooManyRequestsError):
+            self.governor.note_throttle(e.retry_after)
+        elif isinstance(e, self._SEMANTIC):
+            self.governor.note_success()
+        elif isinstance(e, (ClientError, asyncio.TimeoutError)):
+            self.governor.note_failure()
+
+    async def get(self, cls, name, namespace=""):
+        try:
+            r = await self.inner.get(cls, name, namespace)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def list(self, cls, labels=None, namespace=None, index=None):
+        try:
+            r = await self.inner.list(cls, labels, namespace, index)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def create(self, obj):
+        try:
+            r = await self.inner.create(obj)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def update(self, obj):
+        try:
+            r = await self.inner.update(obj)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def update_status(self, obj):
+        try:
+            r = await self.inner.update_status(obj)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def delete(self, cls, name, namespace=""):
+        try:
+            r = await self.inner.delete(cls, name, namespace)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    async def evict(self, name, namespace="", uid=""):
+        try:
+            r = await self.inner.evict(name, namespace, uid)
+        except BaseException as e:
+            self._classify(e)
+            raise
+        self._ok()
+        return r
+
+    def watch(self, cls):
+        return self.inner.watch(cls)
+
+    def add_index(self, cls, name, key_fn):
+        if hasattr(self.inner, "add_index"):
+            self.inner.add_index(cls, name, key_fn)

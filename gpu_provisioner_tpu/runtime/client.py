@@ -43,6 +43,30 @@ class EvictionBlockedError(ClientError):
     transport retry loop."""
 
 
+class ResourceExpiredError(ClientError):
+    """410 Gone / expired resourceVersion from a watch or list.
+
+    The apiserver compacted past the resourceVersion the watch resumed
+    from: the event stream has a hole that retrying the same watch can
+    never fill. The ONLY correct recovery is a fresh relist and a diff
+    against the local cache (client-go reflector Replace() semantics) —
+    which is why error handlers on watch/list paths must branch on this
+    type distinctly from the generic backoff ladder (provlint PL015)."""
+
+
+class TooManyRequestsError(ClientError):
+    """429 from the kube apiserver: throttling, not failure.
+
+    Carries ``retry_after`` (seconds, from the Retry-After header; 0 when
+    absent) so callers pace instead of backing off blindly. Feeds the
+    APIHealthGovernor's AIMD limit — it must never be folded into the
+    consecutive-failure accounting that opens circuit breakers."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message)
+        self.retry_after = max(retry_after, 0.0)
+
+
 def ignore_not_found(exc: Optional[Exception]) -> None:
     if exc is not None and not isinstance(exc, NotFoundError):
         raise exc

@@ -110,6 +110,11 @@ class Controller:
         # assigned by the registry (build_controllers) / operator boot path
         # once leadership is won — construction predates the election
         self.fence = None
+        # APIHealthGovernor, assigned post-construction like the fence: the
+        # workqueue consumes its AIMD limit — each dequeued item waits for
+        # pace() before reconciling, so a browned-out apiserver sees the
+        # fleet's reconcile rate collapse instead of a retry storm
+        self.governor = None
         # assigned by the registry: which shard this controller instance
         # belongs to (labels the per-shard queue-depth gauge)
         self.shard_index = 0
@@ -236,6 +241,12 @@ class Controller:
                 await self.queue.forget(req)
                 await self.queue.done(req)
                 continue
+            if self.governor is not None:
+                # AIMD pacing: free in HEALTHY mode; in degraded modes this
+                # is where the reconcile rate sheds. After the fence check
+                # (a deposed leader must not hold a token) and before the
+                # clock starts (shed wait is not reconcile time).
+                await self.governor.pace()
             start = time.monotonic()
             err: Optional[str] = None
             # The seam's context manager stays open across the requeue

@@ -28,16 +28,23 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Optional
 
 from ..apis.meta import Object
-from . import probes
-from .client import Client
-from .store import ADDED, DELETED, WatchEvent
+from . import apihealth, probes
+from .client import Client, ResourceExpiredError
+from .store import ADDED, DELETED, MODIFIED, WatchEvent
 
 log = logging.getLogger("informer")
 
 RESYNC_SECONDS = 300.0
+
+# Gap-heal relist pacing: jittered so a fleet of informers healing off the
+# same partition doesn't stampede the recovering apiserver, bounded so a
+# still-dead apiserver is probed at a civilized cadence, never slower.
+RELIST_JITTER_BASE = 0.05
+RELIST_BACKOFF_CAP = 1.0
 
 _RELAY_CLOSED = object()
 
@@ -118,6 +125,14 @@ class Informer:
         # always observable through items() by the time a consumer sees it
         self._relays: list[RelayWatch] = []
         self._task: Optional[asyncio.Task] = None
+        # APIHealthGovernor, assigned post-construction (envtest/operator):
+        # the informer reports watch gaps to it; verb outcomes are already
+        # classified by the GovernedClient beneath this cache
+        self.governor = None
+        # cumulative, for tests/debugging (fleet-wide totals live in the
+        # apihealth.APIHEALTH ledger)
+        self.watch_gaps = 0
+        self.relists = 0
 
     def subscribe(self) -> RelayWatch:
         """A watch stream ordered AFTER this cache's updates."""
@@ -126,10 +141,10 @@ class Informer:
     def _apply(self, ev) -> None:
         """Apply one watch event to the cache, then fan it out to relay
         subscribers (strictly in that order — the relay's contract). Events
-        lost while the stream is down are healed for the CACHE by the
-        re-list in _run, and for relay consumers by their controllers'
-        periodic resync timers — RestWatch additionally self-heals with
-        tombstone replay before a break ever surfaces here."""
+        lost while the stream is down are healed by :meth:`_resync`, which
+        diffs the fresh list against this cache and pushes the synthesized
+        ADDED/MODIFIED/DELETED back through here — so relay consumers heal
+        on the same path live events take."""
         if ev.type == DELETED:
             self._remove(ev.object)
         else:
@@ -193,7 +208,7 @@ class Informer:
         # replayed ADDEDs the watch then delivers are idempotent upserts)
         self._watch = self.client.watch(self.cls)
         try:
-            await self._relist()
+            await self._resync()
         except BaseException:
             # don't leak the watch (and its background re-list task) on a
             # failed initial list — a retried start() would orphan it
@@ -219,25 +234,48 @@ class Informer:
         or successful re-list). inf before the first sync."""
         return asyncio.get_event_loop().time() - self.last_sync
 
-    async def _relist(self) -> None:
+    async def _resync(self, sync_events: bool = False) -> None:
+        """Re-list and DIFF against the cache, synthesizing every missed
+        ADDED/MODIFIED/DELETED through :meth:`_apply` — client-go reflector
+        Replace() parity, tombstones included — so relay consumers (the
+        controller pumps) heal through the WakeHub ``watch`` source instead
+        of riding their timer safety nets. With ``sync_events`` (a 410
+        gap heal), UNCHANGED objects are re-delivered as sync MODIFIEDs
+        too: the full-fleet catch-up that guarantees a claim parked across
+        the gap wakes even though its own object never changed.
+
+        No error handling here by design: the caller owns the jittered
+        bounded retry ladder (and the 410-vs-generic classification
+        provlint PL015 pins)."""
         objs = await self.client.list(self.cls)
-        self._cache = {}
-        self._by_label = {}
-        self._by_index = {}
-        for o in objs:
-            self._upsert(o)
+        fresh = {self._key(o) for o in objs}
+        stale = [o for k, o in self._cache.items() if k not in fresh]
+        for obj in objs:
+            old = self._cache.get(self._key(obj))
+            if old is None:
+                self._apply(WatchEvent(ADDED, obj))
+            elif (sync_events or old.metadata.resource_version
+                    != obj.metadata.resource_version):
+                self._apply(WatchEvent(MODIFIED, obj))
+        for old in stale:
+            # the delete happened while the stream was down: synthesize the
+            # tombstone from the last state we knew (client-go's
+            # DeletedFinalStateUnknown analog)
+            self._apply(WatchEvent(DELETED, old))
         self.last_sync = asyncio.get_event_loop().time()
+        self.relists += 1
+        apihealth.note_relist()
 
     async def _run(self) -> None:
         watch = self._watch
         while True:
             loop = asyncio.get_event_loop()
             deadline = loop.time() + self.resync
+            gap = False
             try:
                 # event pump with a hard resync deadline: the timeout fires
-                # even on a totally quiet watch, so deletions missed during
-                # a stream outage (re-lists replay only survivors — no
-                # synthesized DELETEDs) are flushed within one resync period
+                # even on a totally quiet watch, so events missed without a
+                # detectable break are flushed within one resync period
                 while True:
                     remaining = deadline - loop.time()
                     if remaining <= 0:
@@ -266,18 +304,40 @@ class Informer:
             except asyncio.CancelledError:
                 watch.close()
                 raise
+            except ResourceExpiredError as e:
+                # 410 Gone / expired resourceVersion: the stream has a hole
+                # no reconnect can fill — this is the gap-resync path, NOT
+                # the generic backoff ladder (PL015). No punitive sleep:
+                # the jittered relist below is the recovery.
+                log.info("informer %s watch expired: %s", self.cls.KIND, e)
+                gap = True
+                self.watch_gaps += 1
+                apihealth.note_watch_gap()
+                if self.governor is not None:
+                    self.governor.note_watch_gap()
             except Exception as e:  # noqa: BLE001 — cache must self-heal
                 log.warning("informer %s watch broke: %s", self.cls.KIND, e)
                 await asyncio.sleep(1.0)
             finally:
                 watch.close()
-            # same subscribe-before-list ordering as start()
+            # same subscribe-before-list ordering as start(); the relist is
+            # jittered (no heal stampede across informers) and bounded (a
+            # still-dead apiserver is probed at RELIST_BACKOFF_CAP cadence)
             watch = self.client.watch(self.cls)
-            try:
-                await self._relist()
-            except Exception as e:  # noqa: BLE001
-                log.warning("informer %s resync failed: %s", self.cls.KIND, e)
-                await asyncio.sleep(1.0)
+            delay = RELIST_JITTER_BASE * (0.5 + random.random())
+            while True:
+                try:
+                    await asyncio.sleep(delay)
+                    await self._resync(sync_events=gap)
+                    break
+                except asyncio.CancelledError:
+                    watch.close()
+                    raise
+                except Exception as e:  # noqa: BLE001 — retried below
+                    log.warning("informer %s resync failed: %s",
+                                self.cls.KIND, e)
+                    delay = min(delay * 2, RELIST_BACKOFF_CAP)
+                    delay *= 0.5 + random.random()
 
     def items(self, labels: Optional[dict[str, str]] = None,
               namespace: Optional[str] = None,

@@ -19,6 +19,7 @@ import base64
 import json
 import logging
 import os
+import random
 import ssl
 import tempfile
 from dataclasses import dataclass, field, replace
@@ -27,9 +28,12 @@ from typing import Optional
 import httpx
 
 from ..apis.meta import Object, object_from_manifest
-from ..transport import TransportOptions, build_http_client, request_with_retries
+from ..transport import (TransportOptions, build_http_client,
+                         parse_retry_after, request_with_retries)
+from . import apihealth
 from .client import (AlreadyExistsError, ClientError, ConflictError,
-                     EvictionBlockedError, NotFoundError)
+                     EvictionBlockedError, NotFoundError,
+                     ResourceExpiredError, TooManyRequestsError)
 from .store import ADDED, DELETED, MODIFIED, WatchEvent
 
 log = logging.getLogger("rest")
@@ -199,9 +203,19 @@ def _error_for(resp: httpx.Response, verb: str) -> ClientError:
         # verb's 409 is a uid-precondition failure (pod replaced under the
         # same name) and maps to ConflictError like a stale write.
         return AlreadyExistsError(body) if verb == "create" else ConflictError(body)
-    if resp.status_code == 429 and verb == "evict":
-        # A PDB verdict, not apiserver throttling (terminator/eviction.go:199).
-        return EvictionBlockedError(body)
+    if resp.status_code == 429:
+        if verb == "evict":
+            # A PDB verdict, not apiserver throttling (terminator/eviction.go:199).
+            return EvictionBlockedError(body)
+        # genuine throttling that survived the transport's retry budget:
+        # surface it typed, with the server's pacing hint, so the
+        # APIHealthGovernor sheds instead of the breaker judging it
+        return TooManyRequestsError(f"{verb}: HTTP 429: {body}",
+                                    retry_after=parse_retry_after(resp))
+    if resp.status_code == 410:
+        # expired resourceVersion / compacted history: ONLY a relist-and-
+        # diff recovers — never the generic backoff ladder (PL015)
+        return ResourceExpiredError(f"{verb}: HTTP 410 Gone: {body}")
     return ClientError(f"{verb}: HTTP {resp.status_code}: {body}")
 
 
@@ -424,6 +438,16 @@ class RestWatch:
                 # cancelled (a swallowed cancellation here would let a
                 # mid-shutdown awaiter hang; PL002)
                 raise
+            except ResourceExpiredError as e:
+                # 410 Gone / expired resourceVersion: the stream has a hole
+                # no reconnect can fill. Gap-resync path — immediate
+                # jittered re-list (which replays + synthesizes tombstones
+                # above), NOT the generic reconnect backoff (PL015).
+                log.info("watch %s expired: %s; re-listing", self.cls.KIND, e)
+                apihealth.note_watch_gap()
+                rv = ""
+                await asyncio.sleep(
+                    self.RECONNECT_BACKOFF * 0.1 * random.random())
             except Exception as e:
                 log.warning("watch %s broken: %s; re-listing",
                             self.cls.KIND, e)
@@ -457,6 +481,8 @@ class RestWatch:
         async with self.client.http.stream(
                 "GET", resource_path(self.cls), params=params,
                 headers=headers, timeout=timeout) as resp:
+            if resp.status_code == 410:
+                raise ResourceExpiredError("watch: HTTP 410 Gone")
             if resp.status_code >= 400:
                 raise ClientError(f"watch: HTTP {resp.status_code}")
             async for line in resp.aiter_lines():
@@ -470,7 +496,14 @@ class RestWatch:
                 if etype == "BOOKMARK":
                     rv = new_rv or rv
                     continue
-                if etype == "ERROR":  # e.g. 410 Gone — re-list
+                if etype == "ERROR":
+                    # a v1.Status payload: 410 Gone / "Expired" means the
+                    # resourceVersion aged out of etcd's history — typed so
+                    # _run takes the gap-resync path, not the backoff ladder
+                    if (raw.get("code") == 410
+                            or raw.get("reason") == "Expired"):
+                        raise ResourceExpiredError(
+                            f"watch expired: {raw}")
                     raise ClientError(f"watch error event: {raw}")
                 raw.setdefault("kind", self.cls.KIND)
                 raw.setdefault("apiVersion", self.cls.API_VERSION)

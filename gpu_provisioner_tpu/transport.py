@@ -70,6 +70,47 @@ def _notify_breaker_opened(breaker: "CircuitBreaker", state: str) -> None:
             pass
 
 
+# Throttle listeners: ``fn(name, retry_after=...)`` fired on every 429 the
+# retry loop observes. Throttling is PACING, not failure — it never touches
+# the breaker (see record_throttle) — but something above must slow down;
+# the APIHealthGovernor's AIMD limit attaches here (armed from envtest /
+# operator main, like the breaker-open seam).
+_throttle_listeners: list = []
+
+
+def add_throttle_listener(fn) -> None:
+    """Register ``fn(name, retry_after=...)`` for 429 responses
+    (idempotent)."""
+    if fn not in _throttle_listeners:
+        _throttle_listeners.append(fn)
+
+
+def remove_throttle_listener(fn) -> None:
+    try:
+        _throttle_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_throttled(name: str, retry_after: float) -> None:
+    for fn in list(_throttle_listeners):
+        try:
+            fn(name, retry_after=retry_after)
+        except Exception:  # noqa: BLE001 — listeners must not break I/O
+            pass
+
+
+def parse_retry_after(resp) -> float:
+    """Seconds from a Retry-After header; 0.0 when absent or unparseable
+    (HTTP-date form included — honoring delta-seconds covers every real
+    throttler we speak to, and a bad guess must never stall the loop)."""
+    raw = resp.headers.get("Retry-After", "")
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 0.0
+
+
 class BreakerOpenError(Exception):
     """The circuit breaker refused the call without touching the network.
 
@@ -108,6 +149,7 @@ class CircuitBreaker:
         # observability (exported via controllers/metrics.py)
         self.rejected_total = 0
         self.opened_total = 0
+        self.throttled_total = 0
         BREAKERS[name] = self
 
     @property
@@ -154,6 +196,18 @@ class CircuitBreaker:
     def record_success(self) -> None:
         self._failures = 0
         self._opened_at = None
+        self._probe_inflight = False
+
+    def record_throttle(self) -> None:
+        """A 429: the endpoint is alive and pacing us — NEUTRAL for the
+        breaker. Before PR 16 throttled responses took the record_success
+        path, which RESET the consecutive-failure count: a 5xx run
+        interleaved with throttling could never open the breaker, masking
+        a real outage behind the throttler. Now the count survives a 429
+        untouched; only the half-open probe slot is released (a throttled
+        probe proved the endpoint answers, but closing on it would slam a
+        recovering server with the full call rate)."""
+        self.throttled_total += 1
         self._probe_inflight = False
 
     def record_failure(self) -> None:
@@ -239,7 +293,24 @@ async def request_with_retries(http: httpx.AsyncClient, method: str, url: str,
                 breaker.release_probe()
             raise
         else:
-            if breaker is not None:
+            retry_after = 0.0
+            if resp.status_code == 429:
+                # Throttling is pacing, not failure: neutral for the
+                # breaker (consecutive 5xx counts survive), and the server
+                # owns the delay via Retry-After. Fan out to the throttle
+                # listeners so the APIHealthGovernor can shed load fleet-
+                # wide instead of every caller rediscovering the limit.
+                retry_after = parse_retry_after(resp)
+                if breaker is not None:
+                    breaker.record_throttle()
+                if 429 in opts.retryable_status:
+                    # only when this policy treats 429 AS throttling — for
+                    # GCP clients (GCP_RETRYABLE_STATUS) a 429 is the
+                    # semantic stockout answer and must not shed kube load
+                    _notify_throttled(
+                        breaker.name if breaker is not None else url,
+                        retry_after)
+            elif breaker is not None:
                 if resp.status_code in BREAKER_FAILURE_STATUS:
                     breaker.record_failure()
                 else:
@@ -251,7 +322,13 @@ async def request_with_retries(http: httpx.AsyncClient, method: str, url: str,
             break
         delay = min(opts.backoff_cap,
                     opts.backoff_base * (2 ** min(attempt, 6)))
-        await asyncio.sleep(delay * (0.5 + random.random() / 2))
+        delay *= 0.5 + random.random() / 2
+        if last_resp is not None and last_resp.status_code == 429:
+            # honor the server's Retry-After when it asks for MORE than our
+            # backoff would wait; never less — pacing must not turn into
+            # hammering just because the header was small
+            delay = max(delay, parse_retry_after(last_resp))
+        await asyncio.sleep(delay)
     if last_resp is not None:
         return last_resp
     raise last_exc  # type: ignore[misc]

@@ -1,0 +1,37 @@
+"""PL015 true positives: watch/list pumps whose broad error handlers never
+classify expired-resourceVersion — a 410 Gone falls into the generic retry
+path and the pump reconnects forever against compacted history."""
+
+import asyncio
+import logging
+
+log = logging.getLogger("fixture")
+
+
+class Pump:
+    async def _run(self):
+        while True:
+            watch = self.client.watch(self.cls)
+            try:
+                while True:
+                    event = await watch.__anext__()
+                    self._apply(event)
+            except Exception:
+                # swallows 410 Gone into the same one-second reconnect as
+                # any transient error: the cache silently diverges
+                log.warning("watch failed, reconnecting")
+                await asyncio.sleep(1.0)
+
+    async def relist_loop(self):
+        while True:
+            try:
+                objs = await self.client.list(self.cls)
+                self._replace(objs)
+            except ClientError:
+                # a stale-resourceVersion list error needs a fresh relist
+                # from "" — retrying the same RV can never succeed
+                continue
+
+
+class ClientError(Exception):
+    pass

@@ -1,0 +1,54 @@
+"""PL015 abstentions: pumps that classify 410 distinctly, pumps with no
+broad handler, and watch-shaped names that never touch a watch surface."""
+
+import asyncio
+import logging
+
+log = logging.getLogger("fixture")
+
+
+class ResourceExpiredError(Exception):
+    pass
+
+
+class Pump:
+    async def _run(self):
+        # classifies the gap: typed except arm ahead of the broad one
+        while True:
+            watch = self.client.watch(self.cls)
+            try:
+                while True:
+                    event = await watch.__anext__()
+                    self._apply(event)
+            except ResourceExpiredError:
+                await self._resync()
+            except Exception:
+                log.warning("watch failed, reconnecting")
+                await asyncio.sleep(1.0)
+
+    async def provider_pump(self):
+        # classifies via the provider errors' typed predicate
+        while True:
+            try:
+                pages = await self.api.list_pages()
+                self._replace(pages)
+            except Exception as e:
+                if getattr(e, "expired", False):
+                    self._page_token = None
+                continue
+
+    async def _resync(self):
+        # touches list but has NO except handler: the caller owns the
+        # retry ladder (the informer _resync shape) — nothing to classify
+        objs = await self.client.list(self.cls)
+        self._replace(objs)
+
+    async def _run_ticker(self):
+        # pump-shaped name, but never touches a watch/list surface
+        # (providers/operations.py `_run` shape)
+        while True:
+            try:
+                self._tick()
+            except Exception:
+                log.warning("tick failed")
+            await asyncio.sleep(0.05)

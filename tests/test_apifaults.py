@@ -1,0 +1,723 @@
+"""PR 16: degraded-mode control plane — apiserver brownout/partition chaos,
+watch-gap resync, and adaptive overload shedding.
+
+Layers under test:
+
+- ``transport.py``: 429-with-Retry-After is pacing, not failure — neutral
+  for the breaker (consecutive-5xx counts survive), fanned out to the
+  throttle listeners, honored as a backoff floor.
+- ``runtime/apihealth.py``: the AIMD governor and its
+  HEALTHY→BROWNOUT→PARTITIONED→CATCHUP mode machine.
+- ``runtime/informer.py``: 410 Gone → jittered relist → diff-synthesized
+  ADDED/MODIFIED/DELETED through the relay (client-go Replace parity).
+- ``chaos/apifaults.py`` profiles driven through the whole envtest stack,
+  ending in the 200-claim / 30s-partition acceptance soak (slow-marked).
+
+Seeded like the rest of the chaos suite: ``CHAOS_SEED=<n> make brownout``
+reproduces a failure exactly.
+"""
+
+import asyncio
+import os
+
+import httpx
+import pytest
+
+from gpu_provisioner_tpu.analysis.schedfuzz import (
+    FuzzEvent, TraceRecorder, check_partition_fenced_mutate,
+)
+from gpu_provisioner_tpu.apis.core import Node, NodeSpec
+from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+from gpu_provisioner_tpu.apis.meta import CONDITION_READY, ObjectMeta
+from gpu_provisioner_tpu.chaos import (
+    ApiFaultClient, ApiFaultInjector, api_fault_profile,
+)
+from gpu_provisioner_tpu.envtest import Env, EnvtestOptions
+from gpu_provisioner_tpu.fake import make_nodeclaim
+from gpu_provisioner_tpu.observability.flightrecorder import FlightRecorder
+from gpu_provisioner_tpu.runtime import probes
+from gpu_provisioner_tpu.runtime.apihealth import (
+    APIHEALTH, BROWNOUT, CATCHUP, HEALTHY, PARTITIONED, APIHealthGovernor,
+    GovernedClient, PartitionFencedError,
+)
+from gpu_provisioner_tpu.runtime.client import (
+    ClientError, InMemoryClient, NotFoundError, TooManyRequestsError,
+)
+from gpu_provisioner_tpu.runtime.informer import CachedListClient
+from gpu_provisioner_tpu.runtime.store import ADDED, DELETED
+from gpu_provisioner_tpu.runtime.wakehub import SOURCE_TIMER, WAKES
+from gpu_provisioner_tpu.transport import (
+    BREAKER_CLOSED, BREAKER_OPEN, GCP_RETRYABLE_STATUS, CircuitBreaker,
+    TransportOptions, add_throttle_listener, parse_retry_after,
+    remove_throttle_listener, request_with_retries,
+)
+
+from .conftest import async_test, async_test_long
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+# ------------------------------------------------------------------ helpers
+
+def fault_env(faults, launch_timeout: float = 20.0, **opt_kw) -> Env:
+    """Envtest under apiserver weather: informer on (the 410 path belongs
+    to the informer pump — raw manager watches must never see it) and the
+    workqueue backoff left at its production-like defaults, so convergence
+    after a heal PROVES the watch-source wake path instead of leaning on a
+    shortened timer safety net."""
+    opts = EnvtestOptions(api_faults=faults, use_informer=True,
+                          gc_interval=0.25, leak_grace=0.25, **opt_kw)
+    opts.lifecycle.launch_timeout = launch_timeout
+    opts.lifecycle.registration_timeout = launch_timeout
+    return Env(opts)
+
+
+async def wait_for(pred, what: str, timeout: float = 10.0,
+                   tick: float = 0.02) -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred():
+        assert asyncio.get_event_loop().time() < deadline, \
+            f"timed out waiting for {what}"
+        await asyncio.sleep(tick)
+
+
+async def converge(env: Env, names: list[str], timeout: float = 30.0
+                   ) -> set[str]:
+    """Wait until every claim is Ready (reads ride the RAW client)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    ready: set[str] = set()
+    while True:
+        for name in set(names) - ready:
+            try:
+                nc = await env.client.get(NodeClaim, name)
+            except NotFoundError:
+                raise AssertionError(f"claim {name} was LOST") from None
+            if nc.status_conditions.is_true(CONDITION_READY):
+                ready.add(name)
+        if ready == set(names):
+            return ready
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(
+                f"claims did not converge: {len(ready)}/{len(names)} ready; "
+                f"missing={sorted(set(names) - ready)[:8]}")
+        await asyncio.sleep(0.05)
+
+
+def begin_creates(env: Env) -> int:
+    """ADMITTED pool creates (the zone-keyed counters). Post-heal re-walks
+    that 409 against a live pool are adoption — the safe at-least-once
+    answer — and must not count as duplicates; a pool actually admitted
+    twice (carcass replace aside) would."""
+    return sum(v for k, v in env.cloud.nodepools.calls.items()
+               if k.startswith("begin_create:"))
+
+
+def degraded_bundle_keys(rec: FlightRecorder) -> set[str]:
+    return {b["trigger"]["key"].split(":", 1)[1] for b in rec.bundles()
+            if b["trigger"]["kind"] == "degraded-mode"}
+
+
+# ----------------------------------------- transport: 429 is pacing (PR 16a)
+
+@async_test
+async def test_429_preserves_breaker_failure_count():
+    """The regression this PR fixes: the old 429 path called
+    record_success(), RESETTING the consecutive-5xx count — a real outage
+    interleaved with throttling could never open the breaker. 429 must be
+    neutral: no failure, no reset."""
+    script = [503, 503, 429, 503]
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        code = script.pop(0)
+        if code == 429:
+            return httpx.Response(429, headers={"Retry-After": "0.01"})
+        return httpx.Response(code, text="boom")
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    b = CircuitBreaker("pace", failure_threshold=3, reset_timeout=60.0)
+    opts = TransportOptions(max_retries=0, backoff_base=0.001,
+                            backoff_cap=0.002)
+    for _ in range(2):                       # two real failures
+        await request_with_retries(http, "GET", "https://x.test/a",
+                                   opts=opts, breaker=b)
+    assert b.consecutive_failures == 2 and b.state == BREAKER_CLOSED
+    await request_with_retries(http, "GET", "https://x.test/a",
+                               opts=opts, breaker=b)   # throttled
+    assert b.throttled_total == 1
+    assert b.consecutive_failures == 2, \
+        "429 reset the consecutive-failure count — outage masked by throttle"
+    assert b.state == BREAKER_CLOSED, "429 must never count toward opening"
+    await request_with_retries(http, "GET", "https://x.test/a",
+                               opts=opts, breaker=b)   # third real failure
+    assert b.state == BREAKER_OPEN
+    await http.aclose()
+
+
+@async_test
+async def test_sustained_429_never_opens_breaker():
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(429, headers={"Retry-After": "0.001"})
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    b = CircuitBreaker("throttle-only", failure_threshold=3,
+                       reset_timeout=60.0)
+    opts = TransportOptions(max_retries=0, backoff_base=0.001,
+                            backoff_cap=0.002)
+    for _ in range(20):
+        resp = await request_with_retries(http, "GET", "https://x.test/a",
+                                          opts=opts, breaker=b)
+        assert resp.status_code == 429
+    assert b.state == BREAKER_CLOSED and b.throttled_total == 20
+    assert b.consecutive_failures == 0
+    await http.aclose()
+
+
+@async_test
+async def test_429_feeds_throttle_listeners_except_gcp_policy():
+    """Kube-policy 429s fan out Retry-After to the throttle listeners (the
+    governor's transport seam); GCP-policy clients treat 429 as the
+    semantic stockout answer and must NOT shed kube load."""
+    got: list[tuple[str, float]] = []
+
+    def listener(name: str, retry_after: float) -> None:
+        got.append((name, retry_after))
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        return httpx.Response(429, headers={"Retry-After": "0.3"})
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    add_throttle_listener(listener)
+    try:
+        await request_with_retries(
+            http, "GET", "https://x.test/a",
+            opts=TransportOptions(max_retries=0))
+        assert got == [("https://x.test/a", 0.3)]
+        await request_with_retries(
+            http, "GET", "https://x.test/a",
+            opts=TransportOptions(max_retries=0,
+                                  retryable_status=GCP_RETRYABLE_STATUS))
+        assert len(got) == 1, "GCP-policy 429 must not notify kube shedding"
+    finally:
+        remove_throttle_listener(listener)
+        await http.aclose()
+
+
+@async_test
+async def test_retry_after_is_honored_as_delay_floor():
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(429, headers={"Retry-After": "0.25"})
+        return httpx.Response(200, json={})
+
+    http = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    t0 = asyncio.get_event_loop().time()
+    resp = await request_with_retries(
+        http, "GET", "https://x.test/a",
+        opts=TransportOptions(max_retries=1, backoff_base=0.001,
+                              backoff_cap=0.002))
+    elapsed = asyncio.get_event_loop().time() - t0
+    assert resp.status_code == 200
+    assert elapsed >= 0.2, \
+        f"Retry-After floor not honored: retried after {elapsed:.3f}s"
+    await http.aclose()
+
+
+def test_parse_retry_after():
+    mk = lambda headers: httpx.Response(429, headers=headers)  # noqa: E731
+    assert parse_retry_after(mk({"Retry-After": "1.5"})) == 1.5
+    assert parse_retry_after(mk({})) == 0.0
+    assert parse_retry_after(mk({"Retry-After": "soon"})) == 0.0
+    assert parse_retry_after(mk({"Retry-After": "-5"})) == 0.0
+
+
+# ------------------------------------------------- governor mode machine
+
+def test_governor_mode_machine_and_aimd():
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=3,
+                          brownout_hold=1.0, catchup_hold=1.0,
+                          rate_max=256.0)
+    entered: list[str] = []
+    g.add_degraded_listener(lambda mode, **info: entered.append(mode))
+    assert g.mode() == HEALTHY and g.healthz_line() == "ok"
+
+    g.note_throttle(retry_after=0.5)
+    assert g.mode() == BROWNOUT and g._rate == 128.0
+    assert g.status_window_factor() == 4.0
+    assert "degraded mode=BROWNOUT" in g.healthz_line()
+
+    for _ in range(3):
+        g.note_failure()
+    assert g.mode() == PARTITIONED and g.partition_fenced()
+    assert g.status_window_factor() == 8.0
+    assert g.mode_value() == 3 - 1  # PARTITIONED ordinal
+
+    g.note_success()
+    assert g.mode() == CATCHUP and not g.partition_fenced()
+    rate_in_catchup = g._rate
+    g.note_success()
+    assert g._rate == rate_in_catchup + g.increase, "additive increase"
+
+    t["now"] = 5.0              # past both holds
+    assert g.mode() == HEALTHY
+    assert g._rate == g.rate_max, "HEALTHY re-entry restores full pace"
+    assert g.status_window_factor() == 1.0
+    assert entered == [BROWNOUT, PARTITIONED, CATCHUP]
+    assert g.entries_total[PARTITIONED] == 1
+    assert g.entries_total[HEALTHY] == 1
+
+
+def test_governor_brownout_decays_and_throttle_resets_failures():
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=3,
+                          brownout_hold=0.5)
+    g.note_failure()
+    g.note_failure()
+    g.note_throttle()            # an ANSWER: consecutive outage count resets
+    assert g._consec_failures == 0
+    g.note_failure()
+    assert g.mode() == BROWNOUT, "throttle must have reset the outage count"
+    t["now"] = 1.0
+    assert g.mode() == HEALTHY
+
+
+@async_test
+async def test_governor_pace_noop_healthy_sheds_degraded():
+    g = APIHealthGovernor(rate_max=8.0, brownout_hold=60.0)
+    shed_before = APIHEALTH["shed"]
+    t0 = asyncio.get_event_loop().time()
+    for _ in range(50):
+        await g.pace()
+    assert asyncio.get_event_loop().time() - t0 < 0.1, \
+        "HEALTHY pace() must be a no-op fast path"
+    assert APIHEALTH["shed"] == shed_before
+
+    g.note_failure()             # BROWNOUT: rate 8 -> 4, tokens clamp to 4
+    t0 = asyncio.get_event_loop().time()
+    for _ in range(6):
+        await g.pace()
+    assert asyncio.get_event_loop().time() - t0 >= 0.2, \
+        "degraded pace() must actually shed"
+    assert APIHEALTH["shed"] > shed_before
+
+
+def test_governor_emits_api_mode_probes():
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    try:
+        t = {"now": 0.0}
+        g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=1)
+        g.note_failure()         # straight to PARTITIONED (threshold 1)
+        g.note_success()         # CATCHUP
+    finally:
+        probes.remove_sink(rec)
+    modes = [e.key for e in rec.events if e.name == "api-mode"]
+    assert modes == [PARTITIONED, CATCHUP]
+
+
+@async_test
+async def test_governed_client_classifies_outcomes():
+    class StubInner:
+        def __init__(self):
+            self.exc = None
+            self.store = None
+
+        async def get(self, cls, name, namespace=""):
+            if self.exc is not None:
+                raise self.exc
+            return object()
+
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=2,
+                          brownout_hold=60.0)
+    c = GovernedClient(StubInner(), g)
+
+    c.inner.exc = TooManyRequestsError("429", retry_after=0.2)
+    with pytest.raises(TooManyRequestsError):
+        await c.get(Node, "x")
+    assert g.mode() == BROWNOUT and g.throttles_total == 1
+
+    c.inner.exc = NotFoundError("404")      # semantic answer == success
+    with pytest.raises(NotFoundError):
+        await c.get(Node, "x")
+    assert g.failures_total == 0
+
+    c.inner.exc = ClientError("503")
+    for _ in range(2):
+        with pytest.raises(ClientError):
+            await c.get(Node, "x")
+    assert g.mode() == PARTITIONED
+
+    c.inner.exc = None
+    await c.get(Node, "x")
+    assert g.mode() == CATCHUP
+
+
+# ------------------------------------------------ informer gap resync matrix
+
+def _node(name: str) -> Node:
+    return Node(metadata=ObjectMeta(name=name), spec=NodeSpec())
+
+
+@async_test
+async def test_informer_gap_synthesizes_add_and_delete():
+    """Gap matrix rows 1+2: an ADDED dropped during the gap and a DELETED
+    swallowed during the gap both reach relay subscribers as synthesized
+    events after the 410-triggered relist-and-diff."""
+    inner = InMemoryClient()
+    await inner.create(_node("a"))
+    await inner.create(_node("b"))
+    faults = ApiFaultInjector(seed=SEED, gap_start=0.05, gap_duration=0.3)
+    client = CachedListClient(ApiFaultClient(inner, faults), (Node,))
+    await client.start()        # anchors the fault clock
+    try:
+        w = client.watch(Node)
+        replay = sorted([(await asyncio.wait_for(w.__anext__(), 2.0))
+                         .object.metadata.name for _ in range(2)])
+        assert replay == ["a", "b"]
+
+        await asyncio.sleep(0.1)            # into the gap window
+        assert faults.gap_active()
+        await inner.create(_node("c"))      # ADDED — dropped by the stream
+        await inner.delete(Node, "a")       # DELETED — swallowed
+
+        want = {(ADDED, "c"), (DELETED, "a")}
+        seen: set = set()
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while not want <= seen:
+            assert asyncio.get_event_loop().time() < deadline, \
+                f"synthesized events missing: {want - seen}"
+            ev = await asyncio.wait_for(w.__anext__(), 5.0)
+            seen.add((ev.type, ev.object.metadata.name))
+
+        inf = client._informers[Node]
+        assert inf.watch_gaps >= 1, "410 was not classified as a gap"
+        assert inf.relists >= 2, "boot sync + gap resync expected"
+        assert sum(faults.dropped.values()) >= 2
+        names = sorted(n.metadata.name for n in await client.list(Node))
+        assert names == ["b", "c"], "cache did not heal to the true state"
+    finally:
+        await client.stop()
+
+
+@async_test
+async def test_informer_gap_reports_to_governor_and_ledger():
+    inner = InMemoryClient()
+    await inner.create(_node("a"))
+    faults = ApiFaultInjector(seed=SEED, gap_start=0.02, gap_duration=0.15)
+    client = CachedListClient(ApiFaultClient(inner, faults), (Node,))
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"])
+    gaps_before = APIHEALTH["watch_gaps"]
+    await client.start()
+    for inf in client._informers.values():
+        inf.governor = g
+    try:
+        await asyncio.sleep(0.05)
+        await inner.create(_node("dropped"))    # force a lost event
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while APIHEALTH["watch_gaps"] == gaps_before:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert g.mode() == BROWNOUT, "watch gap must be brownout evidence"
+    finally:
+        await client.stop()
+
+
+@async_test
+async def test_soak_watch_gap_relist_races_live_reconciles():
+    """Gap matrix row 3: the relist-and-diff lands while reconciles are
+    live and the status batcher holds pending overlays — everything still
+    converges, with no stale-store spurious status-write storm (the PR 11
+    bug class; bounded by the PR 11 patches-per-claim gate)."""
+    faults = api_fault_profile("watch_gap", seed=SEED,
+                               gap_start=0.15, gap_duration=0.4)
+    names = [f"wg{i}" for i in range(8)]
+    async with fault_env(faults) as env:
+        for n in names[:5]:
+            await env.client.create(make_nodeclaim(n))
+        await wait_for(faults.gap_active, "the watch gap to open")
+        for n in names[5:]:                 # ADDED events land in the gap
+            await env.client.create(make_nodeclaim(n))
+        await converge(env, names)
+        assert set(env.cloud.nodepools.pools) == set(names)
+        assert begin_creates(env) == len(names), "duplicate pool creates"
+        # stale-cache reconciles during the gap re-derive conditions; the
+        # no-op suppression (transition times bump only on flips) must eat
+        # them — count WRITES, not flush attempts
+        writes = env.status_batcher.writes
+        assert writes / len(names) <= 3.0, \
+            f"spurious status writes after relist: {writes}/{len(names)}"
+        gaps = sum(i.watch_gaps
+                   for i in env.informers._informers.values())
+        assert gaps >= 1, "profile never forced a watch gap"
+
+
+# ----------------------------------------------------- profile soaks (fast)
+
+@async_test
+async def test_soak_apiserver_brownout_sheds_and_converges():
+    faults = api_fault_profile("apiserver_brownout", seed=SEED,
+                               brownout_duration=1.5)
+    names = [f"bo{i}" for i in range(8)]
+    shed_before = APIHEALTH["shed"]
+    async with fault_env(faults) as env:
+        for n in names:
+            await env.client.create(make_nodeclaim(n))
+        await converge(env, names)
+        gov = env.governor
+        assert gov.throttles_total + gov.failures_total > 0, \
+            "brownout never reached the governor"
+        assert gov.entries_total.get(BROWNOUT, 0) >= 1
+        assert set(env.cloud.nodepools.pools) == set(names)
+        assert begin_creates(env) == len(names)
+        # one bundle per distinct degraded mode entered; flap re-entries
+        # are suppressed, not duplicated
+        assert degraded_bundle_keys(env.flight_recorder) == \
+            set(gov.entries_total) - {HEALTHY}
+    assert APIHEALTH["shed"] >= shed_before
+
+
+@async_test
+async def test_soak_apiserver_partition_fences_and_converges():
+    faults = api_fault_profile("apiserver_partition", seed=SEED,
+                               partition_start=0.3, partition_duration=1.0)
+    names = [f"pt{i}" for i in range(8)]
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    try:
+        # slow node readiness so the wave is still mid-lifecycle when the
+        # cut lands — an idle fleet sees no verbs fail and proves nothing
+        async with fault_env(faults, node_ready_delay=0.5,
+                             node_join_delay=0.2) as env:
+            for n in names[:5]:
+                await env.client.create(make_nodeclaim(n))
+            await wait_for(faults.partition_active, "the partition to cut")
+            for n in names[5:]:     # born into the outage: ADDEDs drop on
+                await env.client.create(make_nodeclaim(n))  # the dead watch
+            await converge(env, names)
+            gov = env.governor
+            assert gov.entries_total.get(PARTITIONED, 0) >= 1, \
+                "partition never tripped the mode machine"
+            assert gov.entries_total.get(CATCHUP, 0) >= 1
+            assert set(env.cloud.nodepools.pools) == set(names)
+            assert begin_creates(env) == len(names), "duplicate pool creates"
+            assert degraded_bundle_keys(env.flight_recorder) == \
+                set(gov.entries_total) - {HEALTHY}
+    finally:
+        probes.remove_sink(rec)
+    assert check_partition_fenced_mutate(rec.events) == [], \
+        "a cloud mutation landed inside the PARTITIONED window"
+
+
+@async_test
+async def test_soak_catchup_storm_stays_paced():
+    faults = api_fault_profile("catchup_storm", seed=SEED)
+    names = [f"cs{i}" for i in range(10)]
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    try:
+        async with fault_env(faults, launch_timeout=30.0,
+                             node_ready_delay=0.5,
+                             node_join_delay=0.2) as env:
+            for n in names:
+                await env.client.create(make_nodeclaim(n))
+            await converge(env, names, timeout=40.0)
+            gov = env.governor
+            assert gov.entries_total.get(PARTITIONED, 0) >= 1
+            assert gov.entries_total.get(CATCHUP, 0) >= 1
+            assert set(env.cloud.nodepools.pools) == set(names)
+            assert begin_creates(env) == len(names)
+            relists = sum(i.relists
+                          for i in env.informers._informers.values())
+            assert relists > len(env.informers._informers), \
+                "heal_410 must force a full-fleet relist beyond boot syncs"
+    finally:
+        probes.remove_sink(rec)
+    assert check_partition_fenced_mutate(rec.events) == []
+
+
+# -------------------------------------------- provider fence + healthz/metrics
+
+@async_test
+async def test_provider_refuses_cloud_mutation_while_partitioned():
+    """The fence raises BEFORE the fence-check probe: a refused mutation
+    must leave neither a fence-check nor a cloud-mutate event behind."""
+    env = Env(EnvtestOptions(api_governor=False))   # un-started: direct call
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=1)
+    g.note_failure()
+    assert g.partition_fenced()
+    env.provider.api_governor = g
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    try:
+        with pytest.raises(PartitionFencedError):
+            await env.provider.create(make_nodeclaim("fenced"))
+    finally:
+        probes.remove_sink(rec)
+    assert begin_creates(env) == 0, "mutation escaped the partition fence"
+    assert not [e for e in rec.events
+                if e.name in ("fence-check", "cloud-mutate")]
+
+
+@async_test
+async def test_healthz_and_metrics_report_degraded_mode():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpu_provisioner_tpu.controllers.metrics import (
+        DEGRADED_MODE, update_runtime_gauges,
+    )
+    from gpu_provisioner_tpu.operator.server import build_apps
+    from gpu_provisioner_tpu.runtime import Manager
+
+    mgr = Manager(InMemoryClient())
+    t = {"now": 0.0}
+    g = APIHealthGovernor(clock=lambda: t["now"], partition_threshold=1,
+                          catchup_hold=3600.0)
+    metrics_app, health_app = build_apps(mgr)
+    async with TestClient(TestServer(health_app)) as hc:
+        r = await hc.get("/healthz")
+        assert r.status == 200 and await r.text() == "ok"
+        g.note_failure()
+        g.note_success()        # CATCHUP — worst (and sticky: huge hold)
+        r = await hc.get("/healthz")
+        assert r.status == 200, "liveness stays 200: a restart can't help"
+        assert "degraded mode=CATCHUP" in await r.text()
+    update_runtime_gauges(object())
+    assert DEGRADED_MODE._value.get() == 3.0
+    del g                       # drop from GOVERNORS before other tests
+
+
+def test_metrics_ledger_deltas():
+    from gpu_provisioner_tpu.controllers.metrics import (
+        API_SHED_TOTAL, RELISTS_TOTAL, WATCH_GAPS_TOTAL,
+        update_runtime_gauges,
+    )
+    from gpu_provisioner_tpu.runtime import apihealth
+
+    update_runtime_gauges(object())     # flush any prior deltas
+    before = (WATCH_GAPS_TOTAL._value.get(), RELISTS_TOTAL._value.get(),
+              API_SHED_TOTAL._value.get())
+    apihealth.note_watch_gap()
+    apihealth.note_relist()
+    apihealth.note_relist()
+    apihealth.note_shed()
+    update_runtime_gauges(object())
+    assert WATCH_GAPS_TOTAL._value.get() == before[0] + 1
+    assert RELISTS_TOTAL._value.get() == before[1] + 2
+    assert API_SHED_TOTAL._value.get() == before[2] + 1
+
+
+def test_flight_recorder_one_bundle_per_degraded_mode():
+    rec = FlightRecorder()
+    rec.degraded_entered(BROWNOUT, reason="throttled")
+    rec.degraded_entered(BROWNOUT, reason="flap re-entry")
+    rec.degraded_entered(PARTITIONED, reason="outage")
+    assert degraded_bundle_keys(rec) == {BROWNOUT, PARTITIONED}
+    assert rec.triggers_suppressed == 1
+
+
+def test_schedfuzz_partition_fenced_mutate_checker():
+    def ev(i, name, key):
+        return FuzzEvent(i, name, key, "Task-1#abc", {})
+
+    events = [ev(0, "cloud-mutate", "create:p0"),       # HEALTHY: fine
+              ev(1, "api-mode", PARTITIONED),
+              ev(2, "cloud-mutate", "create:p1"),       # violation
+              ev(3, "api-mode", CATCHUP),
+              ev(4, "cloud-mutate", "create:p2")]       # healed: fine
+    out = check_partition_fenced_mutate(events)
+    assert len(out) == 1 and out[0].seq == 2
+    assert "PARTITIONED" in out[0].message
+
+
+def test_api_fault_profiles_are_deterministic():
+    a = api_fault_profile("apiserver_brownout", seed=11)
+    b = api_fault_profile("apiserver_brownout", seed=11)
+    c = api_fault_profile("apiserver_brownout", seed=12)
+    draws_a = [a._draw("throttle", "get", n) for n in range(32)]
+    assert draws_a == [b._draw("throttle", "get", n) for n in range(32)]
+    assert draws_a != [c._draw("throttle", "get", n) for n in range(32)]
+    with pytest.raises(ValueError, match="unknown API fault profile"):
+        api_fault_profile("nope")
+
+
+# ------------------------------------------------- acceptance soak (PR 16)
+
+@pytest.mark.slow
+@async_test_long
+async def test_soak_200_claims_survive_30s_partition():
+    """The PR 16 acceptance bar: a 200-claim wave with a 30-second total
+    apiserver partition dropped mid-wave converges 100%, with zero
+    duplicate pool creates, zero claims lost, exactly one flight-recorder
+    bundle per degraded-mode entered, and a heal-time catch-up that stays
+    inside the PR 11/12 gates (status patches/claim and timer-wake share).
+    The schedfuzz checker replays the probe stream to prove no cloud
+    mutation landed while partition-fenced."""
+    faults = api_fault_profile("apiserver_partition", seed=SEED,
+                               partition_start=0.6,
+                               partition_duration=30.0)
+    names = [f"ap{i:03d}" for i in range(200)]
+    rec = TraceRecorder()
+    probes.add_sink(rec)
+    try:
+        async with fault_env(faults, launch_timeout=90.0,
+                             node_ready_delay=0.3, node_join_delay=0.1,
+                             create_latency=0.05) as env:
+            for n in names[:100]:           # first half: mid-wave cut
+                await env.client.create(make_nodeclaim(n))
+            await wait_for(faults.partition_active, "the partition to cut",
+                           tick=0.05)
+            # second half arrives DURING the outage: their ADDED events die
+            # on the dead watch stream — only the gap resync can find them
+            for n in names[100:]:
+                await env.client.create(make_nodeclaim(n))
+            await wait_for(lambda: not faults.partition_active(),
+                           "the partition to heal", timeout=45.0, tick=0.25)
+            wakes_at_heal = dict(WAKES)
+            await converge(env, names, timeout=90.0)
+
+            # -- zero duplicates, zero losses ----------------------------
+            assert set(env.cloud.nodepools.pools) == set(names)
+            assert begin_creates(env) == len(names), \
+                "duplicate pool creates after the heal"
+
+            # -- mode machine + flight recorder --------------------------
+            gov = env.governor
+            assert gov.entries_total.get(PARTITIONED, 0) >= 1
+            assert gov.entries_total.get(CATCHUP, 0) >= 1
+            assert degraded_bundle_keys(env.flight_recorder) == \
+                set(gov.entries_total) - {HEALTHY}
+
+            # -- catch-up storm inside the PR 11/12 gates ----------------
+            writes = env.status_batcher.writes
+            assert writes / len(names) <= 3.0, \
+                f"status-write storm: {writes / len(names):.2f}/claim"
+            delta = {k: WAKES.get(k, 0) - wakes_at_heal.get(k, 0)
+                     for k in WAKES}
+            wakes = sum(delta.values())
+            timer_share = delta.get(SOURCE_TIMER, 0) / max(wakes, 1)
+            # Catch-up is NOT steady state: 100 claims born during the
+            # outage run their whole lifecycle post-heal, and their
+            # in-progress/registration safety requeues race event
+            # delivery while the CATCHUP pace throttles the backlog —
+            # legitimate timer wakes (measured 0.1-0.2 across runs and
+            # scales; bench_apifaults shares the bound). The gate is for
+            # the real failure: a resync that stops carrying the wake
+            # load pushes the share toward 1.0, not for the PR 12
+            # steady-state 0.05.
+            assert timer_share <= 0.3, (
+                f"catch-up leaned on the timer safety net: "
+                f"{timer_share:.3f} of {wakes} wakes {delta}")
+            assert delta.get("watch", 0) > delta.get(SOURCE_TIMER, 0), \
+                f"watch wakes did not dominate the catch-up: {delta}"
+    finally:
+        probes.remove_sink(rec)
+    assert check_partition_fenced_mutate(rec.events) == [], \
+        "cloud mutation landed while the incarnation was partition-fenced"

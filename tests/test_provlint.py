@@ -44,6 +44,7 @@ CASES = [
     ("PL012", "pl012", {ROLE_PACKAGE}, 2),
     ("PL013", "pl013", {ROLE_PACKAGE}, 3),
     ("PL014", "pl014", {ROLE_CONTROLLERS}, 2),
+    ("PL015", "pl015", {ROLE_RUNTIME}, 2),
 ]
 
 
