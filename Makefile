@@ -90,8 +90,8 @@ test: ## Everything
 	$(PY) -m pytest tests/ -q
 
 .PHONY: bench
-bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12/pr14/pr16 gates
-	$(PY) -m bench.bench_megawave --gate
+bench: ## Provisioning benchmarks; fails on BENCH_pr02/pr04 budget regressions or the BENCH_pr09/pr11/pr12/pr14/pr16/pr19 gates
+	$(PY) -m bench.bench_megawave --gate --procs
 	$(PY) -m bench.bench_provision
 	$(PY) -m bench.bench_fleet --gate
 	$(PY) -m bench.bench_apifaults --gate
@@ -102,12 +102,12 @@ slo: ## fleetscope suite: SLO engine + flight-recorder tests, then the overhead/
 	$(PY) -m bench.bench_fleet --gate
 
 .PHONY: megawave
-megawave: ## Mega-wave smoke: reference gates + a 1k-claim 8-shard wave (full 10k tier: make megawave-full)
-	$(PY) -m bench.bench_megawave --gate
+megawave: ## Mega-wave smoke: reference gates + a 1k-claim 8-shard wave + the multi-process worker tier (full 10k tier: make megawave-full)
+	$(PY) -m bench.bench_megawave --gate --procs
 
 .PHONY: megawave-full
-megawave-full: ## Full mega-wave tier: 10k claims at shard counts 1/4/8; slow — minutes of wall
-	$(PY) -m bench.bench_megawave --full
+megawave-full: ## Full mega-wave tier: 10k claims at in-process shard counts 1/4/8 AND worker-process counts 1/4/8; slow — minutes of wall
+	$(PY) -m bench.bench_megawave --full --procs --procs-full
 
 .PHONY: trace
 trace: ## 100-claim wave under claimtrace; print the critical-path attribution summary

@@ -51,6 +51,7 @@ from pathlib import Path
 
 BENCH_PR11_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr11.json"
 BENCH_PR12_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr12.json"
+BENCH_PR19_FILE = Path(__file__).resolve().parent.parent / "BENCH_pr19.json"
 
 # PR 11 acceptance gates (criteria, not recorded budgets).
 IDLE_FRACTION_MAX = 0.15          # all idle flavors / attributed wall
@@ -82,7 +83,10 @@ def _wake_delta(before: dict) -> dict:
 
 
 def _timer_wake_share(wakes: dict) -> float:
-    total = sum(wakes.values())
+    # timer-arm-skipped is BOOKKEEPING (a safety net never armed, PR 19's
+    # timer diet), not a delivered wake — excluded from the denominator so
+    # the diet shrinks the timer numerator without inflating the total.
+    total = sum(v for k, v in wakes.items() if k != "timer-arm-skipped")
     return round(wakes.get("timer", 0) / total, 4) if total else 0.0
 
 
@@ -350,7 +354,194 @@ def check_megawave(res: dict) -> list[str]:
     return out
 
 
+# ----------------------------------------------------------- process wave
+
+# PR 19 gates for the multi-process tier.
+PROC_IMBALANCE_MAX = 2.0      # peak queue depth, busiest/quietest worker
+# Monotone wall scaling (1→4→8 workers) is a PHYSICAL claim: it needs as
+# many cores as workers. On a smaller host the tier still runs and records,
+# but the scaling gate degrades to an overhead bound: the N-worker wall may
+# not exceed this multiple of the 1-worker wall (the IPC/relay/lease tax).
+PROC_OVERHEAD_MAX = 1.35
+PROC_MONOTONE_SLACK = 1.05    # 5% noise tolerance on the monotone gate
+
+
+async def bench_procwave(n_claims: int, workers: int) -> dict:
+    """``n_claims`` through ``workers`` REAL worker processes: the parent
+    owns the store + fake cloud and serves the shard IPC socket
+    (operator/supervisor.py); each worker is a full operator stack over its
+    lease-owned claim ranges (operator/shardworker.py). The in-process
+    mega-wave above stays as the fairness baseline — this tier is the one
+    with actual parallel event loops."""
+    from gpu_provisioner_tpu.apis.karpenter import NodeClaim
+    from gpu_provisioner_tpu.apis.meta import CONDITION_READY
+    from gpu_provisioner_tpu.fake import make_nodeclaim
+    from gpu_provisioner_tpu.fake.cloud import FakeCloud
+    from gpu_provisioner_tpu.operator.supervisor import ShardSupervisor
+    from gpu_provisioner_tpu.runtime import InMemoryClient
+
+    wait_deadline = max(120.0, n_claims * 0.2)
+    worker_opts = {
+        "max_concurrent_reconciles": 256,
+        "gc_interval": 10.0, "leak_grace": 10.0,
+        "node_wait_attempts": max(1200, int(wait_deadline / 0.02)),
+        "operation_poll_interval": 0.1,
+        "lifecycle.termination_requeue": 0.5,
+        "lifecycle.registration_requeue": 0.5,
+        "lifecycle.status_flush_window": 0.05,
+        "termination.requeue": 0.5,
+        "termination.instance_requeue": 0.5,
+    }
+    raw = InMemoryClient()
+    kube = _CountingClient(raw)
+    cloud = FakeCloud(raw, create_latency=0.05, node_join_delay=0.01,
+                      node_ready_delay=0.01)
+    sup = ShardSupervisor(kube, cloud, worker_opts=worker_opts)
+    await sup.start()
+    depth_peak: dict[str, int] = {}
+
+    async def depth_sampler():
+        while True:
+            for w, snap in sup.snapshots().items():
+                d = sum(snap.get("depths", {}).values())
+                depth_peak[w] = max(depth_peak.get(w, 0), d)
+            await asyncio.sleep(0.1)
+
+    sampler = asyncio.create_task(depth_sampler())
+    try:
+        await sup.spawn(workers)
+        await sup.wait_covered(timeout=90.0, workers=workers)
+        names = [f"p{i:05d}" for i in range(n_claims)]
+        wall0 = time.perf_counter()
+        create0_updates = kube.status_updates
+        sem = asyncio.Semaphore(512)
+
+        async def create(i: int):
+            async with sem:
+                await raw.create(make_nodeclaim(names[i], "tpu-v5e-8",
+                                                workspace=f"ws{i}"))
+
+        await asyncio.gather(*(create(i) for i in range(n_claims)))
+
+        deadline = time.perf_counter() + wait_deadline
+        while True:
+            objs = await raw.list(NodeClaim)
+            ready = sum(1 for o in objs
+                        if o.status_conditions.is_true(CONDITION_READY))
+            if ready >= n_claims:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"proc-wave stalled: {ready}/{n_claims} ready")
+            await asyncio.sleep(0.25)
+        ready_wall = time.perf_counter() - wall0
+        status_patches = kube.status_updates - create0_updates
+        # settle one snapshot interval so every worker's final cumulative
+        # ledger (fresh processes: totals ARE the wave delta) is in
+        await asyncio.sleep(0.5)
+        snaps = sup.snapshots()
+    finally:
+        sampler.cancel()
+        try:
+            await sampler
+        except asyncio.CancelledError:
+            pass
+        routed, dropped = sup.server.wakes_routed, sup.server.wakes_dropped
+        await sup.stop()
+
+    wakes: dict[str, int] = {}
+    forwarded = delivered = 0
+    batch = {"submitted": 0, "coalesced": 0}
+    for snap in snaps.values():
+        for source, n in snap.get("wakes", {}).items():
+            wakes[source] = wakes.get(source, 0) + n
+        hub = snap.get("hub", {})
+        forwarded += hub.get("forwarded", 0)
+        delivered += hub.get("delivered", 0)
+        for k in batch:
+            batch[k] += snap.get("batcher", {}).get(k, 0)
+    depths = [depth_peak.get(w, 0) for w in sorted(depth_peak)]
+    return {
+        "claims": n_claims,
+        "workers": workers,
+        "ready_wall_s": round(ready_wall, 3),
+        "status_patches": status_patches,
+        "status_patches_per_claim": round(status_patches / n_claims, 3),
+        "peak_queue_depth_by_worker": depths,
+        "peak_depth_imbalance": (round(max(depths) / max(min(depths), 1), 2)
+                                 if workers > 1 and depths else 1.0),
+        "wakes_by_source": wakes,
+        "timer_wake_share": _timer_wake_share(wakes),
+        "timer_arm_skipped": wakes.get("timer-arm-skipped", 0),
+        "wakes_delivered": delivered,
+        "wakes_forwarded_cross_process": forwarded,
+        "ipc_wakes_routed": routed,
+        "ipc_wakes_dropped": dropped,
+        "status_batcher": batch,
+    }
+
+
+def check_procwave(waves: list[dict], cores: int) -> list[str]:
+    out: list[str] = []
+    for w in waves:
+        out += check_timer_share(w, f"proc-wave@{w['workers']}w")
+        if (w["workers"] > 1
+                and w["peak_depth_imbalance"] > PROC_IMBALANCE_MAX):
+            out.append(
+                f"proc-wave@{w['workers']}w: peak depth imbalance "
+                f"{w['peak_depth_imbalance']}x > {PROC_IMBALANCE_MAX}x — "
+                f"lease fair-share is not spreading the wave "
+                f"(peaks {w['peak_queue_depth_by_worker']})")
+    walls = {w["workers"]: w["ready_wall_s"] for w in waves}
+    if len(walls) < 2:
+        return out
+    counts = sorted(walls)
+    if cores >= max(counts):
+        for lo, hi in zip(counts, counts[1:]):
+            if walls[hi] > walls[lo] * PROC_MONOTONE_SLACK:
+                out.append(
+                    f"proc-wave wall NOT monotone: {walls[hi]}s @ {hi}w > "
+                    f"{walls[lo]}s @ {lo}w (+5% slack) on a {cores}-core "
+                    f"host — worker processes are not scaling")
+    else:
+        base = walls[counts[0]]
+        for c in counts[1:]:
+            if walls[c] > base * PROC_OVERHEAD_MAX:
+                out.append(
+                    f"proc-wave@{c}w wall {walls[c]}s > "
+                    f"{PROC_OVERHEAD_MAX}x the 1-worker {base}s on a "
+                    f"{cores}-core host — the IPC/relay/lease tax grew "
+                    f"(monotone-speedup gate needs >= {max(counts)} cores)")
+    return out
+
+
 # ------------------------------------------------------------------- budget
+
+def make_proc_budget(gate_procs: list[dict]) -> dict:
+    """3× headroom over the gate-tier proc-wave walls, keyed by worker
+    count — the cross-machine-tolerant regression tripwire."""
+    return {
+        "claims": gate_procs[0]["claims"],
+        "wall_ceiling_s": {str(w["workers"]): round(3.0 * w["ready_wall_s"],
+                                                    1)
+                           for w in gate_procs},
+    }
+
+
+def check_proc_budget(gate_procs: list[dict], recorded: dict) -> list[str]:
+    budget = recorded.get("budget", {})
+    ceilings = budget.get("wall_ceiling_s", {})
+    out: list[str] = []
+    for w in gate_procs:
+        ceiling = ceilings.get(str(w["workers"]))
+        if (ceiling is not None and w["claims"] == budget.get("claims")
+                and w["ready_wall_s"] > ceiling):
+            out.append(
+                f"proc-wave wall regressed: {w['ready_wall_s']}s > budget "
+                f"{ceiling}s at {w['claims']} claims / "
+                f"{w['workers']} workers")
+    return out
+
 
 def make_budget(gate_wave: dict) -> dict:
     """3× headroom over the gate-tier mega-wave wall (scales with machine
@@ -437,6 +628,21 @@ def main(argv=None) -> int:
     ap.add_argument("--write-pr12", action="store_true",
                     help="record the gate-tier run (wake-source ledger + "
                          "timer_wake_share) as BENCH_pr12.json")
+    ap.add_argument("--procs", action="store_true",
+                    help="multi-process shard tier: REAL worker processes "
+                         "over the shard IPC socket, gate-sized")
+    ap.add_argument("--procs-claims", type=int, default=300,
+                    help="gate-tier proc-wave size")
+    ap.add_argument("--procs-workers", type=str, default="1,2",
+                    help="comma-separated worker counts for the gate "
+                         "proc tier")
+    ap.add_argument("--procs-full", action="store_true",
+                    help="full proc tier: --full-claims claims at worker "
+                         "counts 1/4/8 (slow)")
+    ap.add_argument("--procs-full-workers", type=str, default="1,4,8")
+    ap.add_argument("--write-pr19", action="store_true",
+                    help="record the proc-tier runs + budget as "
+                         "BENCH_pr19.json")
     args = ap.parse_args(argv)
 
     rc = 0
@@ -473,6 +679,54 @@ def main(argv=None) -> int:
         if args.write_pr12:
             BENCH_PR12_FILE.write_text(json.dumps(results, indent=2) + "\n")
             print(f"wrote {BENCH_PR12_FILE}", file=sys.stderr)
+
+    if args.procs or args.procs_full:
+        import os
+        cores = os.cpu_count() or 1
+        gate_procs = []
+        for n in (int(s) for s in args.procs_workers.split(",")):
+            gate_procs.append(asyncio.run(bench_procwave(args.procs_claims,
+                                                         n)))
+            print(f"  proc-wave {args.procs_claims} claims @ {n} worker"
+                  f"(s): {gate_procs[-1]['ready_wall_s']}s",
+                  file=sys.stderr)
+        violations += check_procwave(gate_procs, cores)
+        procs_results = {
+            "bench": "megawave-procs",
+            "pr": 19,
+            "host_cores": cores,
+            "note": ("worker processes have their OWN event loops — this "
+                     "tier measures real parallel scaling. The monotone-"
+                     "speedup gate applies only when host_cores >= the "
+                     "largest worker count; below that it degrades to the "
+                     f"{PROC_OVERHEAD_MAX}x IPC-overhead bound (see "
+                     "docs/PERFORMANCE.md, Multi-process shards)"),
+            "gate_procs": gate_procs,
+            "gates": {"timer_wake_share_max": TIMER_WAKE_SHARE_MAX,
+                      "peak_depth_imbalance_max": PROC_IMBALANCE_MAX,
+                      "monotone_slack": PROC_MONOTONE_SLACK,
+                      "overhead_max_sub_core": PROC_OVERHEAD_MAX},
+        }
+        if args.procs_full:
+            full_procs = []
+            for n in (int(s) for s in args.procs_full_workers.split(",")):
+                full_procs.append(asyncio.run(
+                    bench_procwave(args.full_claims, n)))
+                print(f"  proc-wave {args.full_claims} claims @ {n} "
+                      f"worker(s): {full_procs[-1]['ready_wall_s']}s",
+                      file=sys.stderr)
+            violations += check_procwave(full_procs, cores)
+            procs_results["full_procs"] = full_procs
+        results["procs"] = procs_results
+        print(json.dumps({"procs": procs_results}, indent=2))
+        if BENCH_PR19_FILE.exists():
+            recorded = json.loads(BENCH_PR19_FILE.read_text())
+            violations += check_proc_budget(gate_procs, recorded)
+        if args.write_pr19:
+            procs_results["budget"] = make_proc_budget(gate_procs)
+            BENCH_PR19_FILE.write_text(
+                json.dumps(procs_results, indent=2) + "\n")
+            print(f"wrote {BENCH_PR19_FILE}", file=sys.stderr)
 
     for v in violations:
         print(f"MEGAWAVE GATE: {v}", file=sys.stderr)

@@ -422,6 +422,17 @@ _ABOVE_RUNTIME = {"providers", "cloudprovider", "controllers", "operator",
 # The ROADMAP item-4 provider seam: cloud-specific modules only the
 # provider layer itself (and the operator composition root) may import.
 _CLOUD_SPECIFIC = ("providers.gcp", "providers.rest")
+# The multi-process shard seam (PG005): workers are shared-nothing OS
+# processes, and these three modules are the ONLY legal cross-shard
+# channel (lease handoff, informer relay, wake transport, cloud proxying).
+# A module outside the seam importing into it is reaching for another
+# shard's in-process state — exactly the coupling that would silently
+# re-serialize the fleet onto one event loop.
+_SHARD_SEAM = ("operator.supervisor", "operator.shardworker",
+               "runtime.shardipc")
+# Read-only consumers of the seam's WIRE data (cumulative snapshots), not
+# its live state: the /metrics scrape folds worker ledgers at the parent.
+_SHARD_SEAM_READERS = ("controllers.metrics",)
 
 
 def check_layering(g: ProgramGraph) -> list[RawFinding]:
@@ -516,6 +527,24 @@ def check_fence_flow(g: ProgramGraph) -> list[RawFinding]:
     return out
 
 
+def check_shard_isolation(g: ProgramGraph) -> list[RawFinding]:
+    seam = {f"{g.package}.{m}" for m in _SHARD_SEAM}
+    readers = {f"{g.package}.{m}" for m in _SHARD_SEAM_READERS}
+    out: list[RawFinding] = []
+    for e in g.import_edges:
+        if e.dst not in seam or e.src in seam or e.src in readers:
+            continue
+        if g.segment(e.src) == "operator":
+            continue  # the composition root wires the seam together
+        out.append((g.modules[e.src].display, e.line, (
+            f"{e.src} imports shard-seam module {e.dst}: workers are "
+            f"shared-nothing processes and only the supervisor/relay seam "
+            f"(operator.supervisor, operator.shardworker, runtime.shardipc) "
+            f"may touch another shard's in-process state — route through "
+            f"the lease table, the relay, or the wake transport instead")))
+    return out
+
+
 def check_metrics_docs(g: ProgramGraph) -> list[RawFinding]:
     if g.doc_path is None:
         return []
@@ -556,6 +585,12 @@ RULES: list[GraphRule] = [
     GraphRule("PG004", "metrics-docs-drift",
               "tpu_provisioner_* names in code and docs/OBSERVABILITY.md "
               "must match exactly, both directions", check_metrics_docs),
+    GraphRule("PG005", "shard-isolation",
+              "an import into the multi-process shard seam (operator."
+              "supervisor / operator.shardworker / runtime.shardipc) from "
+              "outside it — cross-shard state must travel the lease/relay/"
+              "wake channels, never an in-process reference",
+              check_shard_isolation),
 ]
 
 

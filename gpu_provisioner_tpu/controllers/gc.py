@@ -49,6 +49,12 @@ class GCOptions:
     # bound (2× the informer resync: one missed re-list is jitter, two is
     # an outage). 0 disables.
     max_cache_age: float = 600.0
+    # Range-ownership predicate ``owns(name) -> bool`` for multi-process
+    # shard workers (registry distribute_singletons): each worker's GC
+    # loops reap only cloud/cluster resources in its leased ranges —
+    # instance names equal claim names equal pool names, so one predicate
+    # partitions both directions consistently. None = whole fleet.
+    owns: object = None
 
 
 def _cache_age(client, cls) -> float:
@@ -101,6 +107,9 @@ class InstanceGCController:
                             NodeClaim, Node):
             return
         instances = await self.cp.list()
+        if self.opts.owns is not None:
+            instances = [i for i in instances
+                         if self.opts.owns(i.metadata.name)]
         claims = {nc.metadata.name for nc in await list_managed(self.client)}
 
         leaked = []
@@ -147,6 +156,8 @@ class InstanceGCController:
             owned = node.metadata.labels.get(wk.NODEPOOL_LABEL) == wk.KAITO_NODEPOOL_NAME
             if not pool or not owned or pool in live_pools:
                 continue
+            if self.opts.owns is not None and not self.opts.owns(pool):
+                continue
             if node.metadata.deletion_timestamp is not None:
                 continue
             log.info("instance GC: deleting orphan node %s (pool %s)",
@@ -179,6 +190,9 @@ class NodeClaimGCController:
                      if i.status.provider_id}
         doomed = []
         for nc in await list_managed(self.client):
+            if (self.opts.owns is not None
+                    and not self.opts.owns(nc.metadata.name)):
+                continue
             if nc.metadata.deletion_timestamp is not None:
                 continue
             reg = nc.status_conditions.get(REGISTERED)

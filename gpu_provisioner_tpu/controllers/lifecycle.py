@@ -42,6 +42,7 @@ from ..errors import (
 from ..providers.operations import loop_now
 from ..runtime import NotFoundError, Request, Result
 from ..runtime.client import Client, patch_retry
+from ..runtime.wakehub import SOURCE_LRO, SOURCE_NODE
 from .statusbatch import write_claim_patches
 from ..runtime.events import Recorder
 from ..scheduling import merge_taints, remove_taint
@@ -152,7 +153,7 @@ class NodeClaimLifecycleController:
         # All sub-reconcilers run even when one errors (the reference
         # aggregates errors with multierr, controller.go:149-157) — liveness
         # must still fire while launch is failing.
-        requeues: list[float] = []
+        requeues: list[tuple[float, Optional[str]]] = []
         preserve = False
         error: Optional[Exception] = None
         for sub in (self._launch, self._registration, self._initialization,
@@ -167,18 +168,31 @@ class NodeClaimLifecycleController:
             if res is None:
                 return Result()  # nodeclaim was deleted by the sub-reconciler
             if res.requeue_after is not None:
-                requeues.append(res.requeue_after)
+                requeues.append((res.requeue_after, res.wake_source))
             preserve = preserve or res.preserve_failures
         await self._flush_status(nc)
         if error is not None:
             raise error
+        if not requeues:
+            return Result(preserve_failures=preserve)
+        after, source = min(requeues, key=lambda p: p[0])
+        # The min park's wake source survives the fold so the controller
+        # can skip its safety-net arm — but an UN-sourced deadline folded
+        # above it (the liveness budget: nothing but a timer can end that
+        # wait) must still be armed, or the skip would silently disable the
+        # liveness enforcement clock.
+        fallback = None
+        if source is not None:
+            unsourced = [a for a, s in requeues if s is None]
+            if unsourced:
+                fallback = min(unsourced)
         # wakes: aggregate — min of the sub-reconcilers' annotated waits
         # provgraph: disable=PG002 — 'aggregate' is not a wake SOURCE: each
         # folded requeue_after carries its own `# wakes:` annotation at the
         # sub-reconciler site, and those are the edges PG002 checks; this
         # line only documents the min() fold
-        return Result(requeue_after=min(requeues) if requeues else None,
-                      preserve_failures=preserve)
+        return Result(requeue_after=after, preserve_failures=preserve,
+                      wake_source=source, fallback_after=fallback)
 
     async def _flush_status(self, nc: NodeClaim, direct: bool = False) -> None:
         """Persist ``nc``'s meta+status. With a batcher, submit into its
@@ -251,7 +265,8 @@ class NodeClaimLifecycleController:
                     # instead of climbing the ladder.
                     # wakes: lro — tracker completion via the WakeHub
                     return Result(requeue_after=self.opts.inprogress_requeue,
-                                  preserve_failures=True)
+                                  preserve_failures=True,
+                                  wake_source=SOURCE_LRO)
                 # Other transient reasons (NodesNotReady, QueuedProvisioning)
                 # deliberately take the workqueue's exponential error backoff:
                 # at fleet scale it is the self-stabilizing mechanism — a
@@ -288,7 +303,8 @@ class NodeClaimLifecycleController:
             cs.set_false(REGISTERED, "AwaitingNodes",
                          f"{len(nodes)}/{hosts} slice nodes present")
             # wakes: node — Node watch source wakes the claim on arrival
-            return Result(requeue_after=self.opts.registration_requeue)
+            return Result(requeue_after=self.opts.registration_requeue,
+                          wake_source=SOURCE_NODE)
 
         for node in nodes:
             await self._sync_node(nc, node)
@@ -347,7 +363,8 @@ class NodeClaimLifecycleController:
             cs.set_false(INITIALIZED, "NodesNotReady",
                          f"waiting on {not_ready or 'missing nodes'}")
             # wakes: node — readiness flips arrive on the Node watch
-            return Result(requeue_after=self.opts.registration_requeue)
+            return Result(requeue_after=self.opts.registration_requeue,
+                          wake_source=SOURCE_NODE)
 
         startup_tainted = [n.metadata.name for n in nodes
                            if _has_startup_taints(n, nc)]
@@ -355,7 +372,8 @@ class NodeClaimLifecycleController:
             cs.set_false(INITIALIZED, "StartupTaintsPresent",
                          f"startup taints on {startup_tainted}")
             # wakes: node — taint removal arrives on the Node watch
-            return Result(requeue_after=self.opts.registration_requeue)
+            return Result(requeue_after=self.opts.registration_requeue,
+                          wake_source=SOURCE_NODE)
 
         missing = [n.metadata.name for n in nodes if not _tpu_registered(n)]
         if missing:
@@ -364,7 +382,8 @@ class NodeClaimLifecycleController:
             cs.set_false(INITIALIZED, "ResourcesNotRegistered",
                          f"google.com/tpu not registered on {missing}")
             # wakes: node — device-plugin registration is a Node update
-            return Result(requeue_after=self.opts.registration_requeue)
+            return Result(requeue_after=self.opts.registration_requeue,
+                          wake_source=SOURCE_NODE)
 
         cs.set_true(INITIALIZED, "Initialized")
         self._annotate(nc.metadata.name, "ready")

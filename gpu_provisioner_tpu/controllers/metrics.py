@@ -122,6 +122,11 @@ REQUEUE_WAKES_TOTAL = _get_or_create(
 
 _wakes_seen: dict[str, int] = {}
 
+# Worker-process wake ledgers fold through the shard IPC snapshots (see
+# update_runtime_gauges): cumulative per (worker, source), so a restarted
+# worker's counter reset shows up as a negative delta and is skipped.
+_worker_wakes_seen: dict[tuple[str, str], int] = {}
+
 # --------------------------------------------------------- crash recovery
 
 RECOVERY_ADOPTED = _get_or_create(
@@ -406,6 +411,23 @@ def update_runtime_gauges(manager) -> None:
         if delta > 0:
             REQUEUE_WAKES_TOTAL.labels(source).inc(delta)
             _wakes_seen[source] = n
+    # Multi-process shards: each worker pushes a cumulative stats snapshot
+    # over the shard IPC socket; the parent's scrape folds them in here —
+    # queue depths as shard={worker} series, wake ledgers delta-fed into
+    # the same counter family the local hub feeds.
+    from ..runtime import shardipc as _shardipc
+    worker_wakes: dict[str, int] = {}
+    for server in list(_shardipc.SERVERS):
+        for worker, snap in list(server.snapshots.items()):
+            SHARD_QUEUE_DEPTH.labels(worker).set(
+                sum(snap.get("depths", {}).values()))
+            for source, n in snap.get("wakes", {}).items():
+                worker_wakes[source] = worker_wakes.get(source, 0) + n
+                delta = n - _worker_wakes_seen.get((worker, source), 0)
+                if delta > 0:
+                    REQUEUE_WAKES_TOTAL.labels(source).inc(delta)
+                if delta:
+                    _worker_wakes_seen[(worker, source)] = n
     for name, stats in CACHE_STATS.items():
         for stat, gauge in _CACHE_GAUGES:
             gauge.labels(name).set(stats[stat])
@@ -461,11 +483,18 @@ def update_runtime_gauges(manager) -> None:
             _BREAKER_STATE_VALUE.get(breaker.state, 0.0))
         BREAKER_REJECTED.labels(name).set(breaker.rejected_total)
         _exported_breakers.add(name)
-    # Wake-source share: derived from the same ledger the delta loop above
-    # consumes — timer wakes over all wakes since process start.
-    total_wakes = sum(_wakehub.WAKES.values())
+    # Wake-source share: local ledger plus every worker's, timer wakes over
+    # all DELIVERED wakes since process start. The skipped-arm ledger key is
+    # bookkeeping (timers never armed), not a wake — excluded from both
+    # sides so the diet shrinks the numerator without inflating the total.
+    combined = dict(_wakehub.WAKES)
+    for source, n in worker_wakes.items():
+        combined[source] = combined.get(source, 0) + n
+    combined.pop(_wakehub.SKIPPED_TIMER_ARM, None)
+    total_wakes = sum(combined.values())
     if total_wakes:
-        TIMER_WAKE_SHARE.set(_wakehub.WAKES.get("timer", 0) / total_wakes)
+        TIMER_WAKE_SHARE.set(
+            combined.get(_wakehub.SOURCE_TIMER, 0) / total_wakes)
     from ..observability import fleet as _fleet
     from ..observability import flightrecorder as _flightrecorder
     claims = 0

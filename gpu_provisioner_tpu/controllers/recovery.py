@@ -75,6 +75,10 @@ class RecoveryOptions:
     grace: float = 30.0
     # Refuse to reap on a stale cached claim view (GC's watch-age bound).
     max_cache_age: float = 600.0
+    # Range-ownership predicate for multi-process shard workers (same
+    # contract as GCOptions.owns): the audit adopts/reaps only pools and
+    # queued resources whose name falls in this worker's leased ranges.
+    owns: object = None
 
 
 class RecoveryController:
@@ -130,6 +134,8 @@ class RecoveryController:
                   for nc in await list_managed(self.client)}
 
         for pool in pools:
+            if self.opts.owns is not None and not self.opts.owns(pool.name):
+                continue
             if not (pool_owned_by_kaito(pool)
                     and pool_created_from_nodeclaim(pool)):
                 continue
@@ -180,6 +186,8 @@ class RecoveryController:
                             "resumed after restart via lifecycle re-drive")
 
         for qr in queued:
+            if self.opts.owns is not None and not self.opts.owns(qr.name):
+                continue
             nc = claims.get(qr.name)
             if nc is None:
                 await self._reap_qr(qr.name)

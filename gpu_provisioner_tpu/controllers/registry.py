@@ -18,7 +18,9 @@ from ..apis.karpenter import NodeClaim
 from ..runtime import Controller, Request, Singleton
 from ..runtime.client import Client
 from ..runtime.events import Recorder
-from ..runtime.wakehub import SOURCE_LRO, SOURCE_NODE, WakeHub
+from ..runtime.wakehub import (
+    SOURCE_LRO, SOURCE_NODE, SOURCE_STATUS_FLUSH, WakeHub,
+)
 from .gc import GCOptions, InstanceGCController, NodeClaimGCController
 from .health import HealthOptions, NodeHealthController
 from .lifecycle import LifecycleOptions, NodeClaimLifecycleController
@@ -70,6 +72,8 @@ def build_controllers(client: Client, cloudprovider,
                       tracer=None,
                       wakehub=None,
                       status_batcher=None,
+                      owns=None,
+                      distribute_singletons: bool = False,
                       ) -> tuple[list[Controller], EvictionQueue]:
     """Assemble the active controller set. ``max_concurrent_reconciles``
     scales the lifecycle worker pool (reference: 1000-5000 CPU-scaled,
@@ -120,8 +124,16 @@ def build_controllers(client: Client, cloudprovider,
     of non-blocking mode)."""
     if not 0 <= shard_index < shards:
         raise ValueError(f"shard_index {shard_index} outside [0, {shards})")
-    owns = (lambda name: True) if shards == 1 else \
-        (lambda name: shard_owns(name, shards, shard_index))
+    # ``owns``: dynamic range-ownership predicate (a ShardLeaseTable's
+    # ``owns`` in a multi-process worker) — supersedes the static crc32
+    # partition. Unlike the static split it can CHANGE between enqueue and
+    # dequeue (lease handoff), so claim-keyed controllers also re-check it
+    # at dequeue (Controller.owns) and the singletons run per-range lessees
+    # (``distribute_singletons``) instead of pinning to shard 0.
+    dynamic_owns = owns is not None
+    if owns is None:
+        owns = (lambda name: True) if shards == 1 else \
+            (lambda name: shard_owns(name, shards, shard_index))
 
     def claim_map(nc) -> list[Request]:
         name = nc.metadata.name
@@ -149,6 +161,15 @@ def build_controllers(client: Client, cloudprovider,
     # with the provider's stockout parking.
     if wakehub is None:
         wakehub = WakeHub()
+    # Announce the live event wake producers (gates the safety-net timer
+    # diet — Result.wake_source parks skip their arm only for announced
+    # sources): the Node watch is always wired into lifecycle below; LRO
+    # completions only exist with a tracker; status-flush with a batcher.
+    wakehub.announce(SOURCE_NODE)
+    if tracker is not None:
+        wakehub.announce(SOURCE_LRO)
+    if status_batcher is not None:
+        wakehub.announce(SOURCE_STATUS_FLUSH)
     lifecycle = NodeClaimLifecycleController(client, cloudprovider, recorder,
                                             lifecycle_options, tracer=tracer,
                                             status_batcher=status_batcher)
@@ -180,7 +201,23 @@ def build_controllers(client: Client, cloudprovider,
                    **hardening)
         .watches(Node, map_fn=node_map),
     ]
-    if shard_index == 0:
+    slicegroup_map = group_requests
+    if distribute_singletons:
+        # Per-range lessees instead of shard-0 pins: every worker runs the
+        # GC/recovery/slice-group loops over ITS OWNED RANGE ONLY — the
+        # owns predicate filters both cloud listings (GC/recovery) and the
+        # group-keyed watch map (slice-group). A dead worker's range moves
+        # with its leases, so its GC debt is adopted, not orphaned.
+        if gc_options is None:
+            gc_options = GCOptions()
+        if recovery_options is None:
+            recovery_options = RecoveryOptions()
+        gc_options.owns = owns
+        recovery_options.owns = owns
+
+        def slicegroup_map(obj, _owns=owns):  # noqa: F811 — scoped override
+            return [r for r in group_requests(obj) if _owns(r.name)]
+    if shard_index == 0 or distribute_singletons:
         instance_gc = InstanceGCController(client, cloudprovider, gc_options)
         nodeclaim_gc = NodeClaimGCController(client, cloudprovider,
                                              gc_options)
@@ -198,8 +235,8 @@ def build_controllers(client: Client, cloudprovider,
             Controller(SliceGroupController.NAME,
                        SliceGroupController(client, cluster=cluster),
                        max_concurrent=4, **hardening)
-            .watches(Node, map_fn=group_requests)
-            .watches(NodeClaim, map_fn=group_requests),
+            .watches(Node, map_fn=slicegroup_map)
+            .watches(NodeClaim, map_fn=slicegroup_map),
         ]
     # Node health only with repair policies + gate (controllers.go:110-113).
     # Repair drains through the SAME eviction queue the termination
@@ -224,6 +261,15 @@ def build_controllers(client: Client, cloudprovider,
         c.set_exhausted_hook(exhausted_hook)
         c.fence = fence
         c.shard_index = shard_index  # labels the shard queue-depth gauge
+        c.wake_hub = wakehub  # gates the Result.wake_source timer-arm skip
+        # Dequeue-time ownership fence, dynamic partitions only: applied to
+        # the controllers whose REQUEST KEY is the partition key (claim
+        # name for lifecycle, group name for slice-group) — node-keyed
+        # controllers shard by pool label, which the dequeue-side check
+        # cannot recompute from the request alone.
+        if dynamic_owns and c.name in (lifecycle.NAME,
+                                       SliceGroupController.NAME):
+            c.owns = owns
         # singletons reconcile a synthetic tick, not a claim — tracing
         # them would grow one junk trace per singleton name
         if trace_seam is not None and not c.singleton:

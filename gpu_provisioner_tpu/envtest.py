@@ -153,6 +153,13 @@ class EnvtestOptions:
     # Startup resync/orphan-adoption cadence (controllers/recovery.py);
     # the boot pass always fires immediately.
     recovery_interval: float = 600.0
+    # Multi-process shard workers (operator/shardworker.py): a dynamic
+    # range-ownership predicate (a runtime/shardlease.ShardLeaseTable's
+    # ``owns``) supersedes the static crc32 shards/shard_index partition,
+    # and distribute_singletons runs GC/recovery/slice-group assignment as
+    # per-range lessees instead of pinning them to shard 0.
+    owns_fn: object = None
+    distribute_singletons: bool = False
     # Runtime detectors (analysis/detectors.py), ON by default — every
     # envtest-driven test runs under them:
     # - stall_budget: the event-loop stall detector fails the Env at
@@ -223,8 +230,12 @@ class Env:
                  fence=None):
         self.opts = options or EnvtestOptions()
         self.client = client if client is not None else InMemoryClient()
-        self.client.store.add_index(Node, "spec.providerID",
-                                    lambda o: [o.spec.provider_id])
+        # remote clients (runtime/shardipc.SocketClient) have no local
+        # store; the supervisor registers the index on the parent's
+        store = getattr(self.client, "store", None)
+        if store is not None:
+            store.add_index(Node, "spec.providerID",
+                            lambda o: [o.spec.provider_id])
         if cloud is None:
             cloud = _make_cloud(self.opts, self.client)
         elif self.opts.chaos is not None and cloud.chaos is not self.opts.chaos:
@@ -374,7 +385,9 @@ class Env:
                 grace=self.opts.leak_grace),
             crashes=self.opts.crashes, fence=fence,
             tracker=self.tracker, tracer=self.tracer,
-            wakehub=self.wakehub, status_batcher=self.status_batcher)
+            wakehub=self.wakehub, status_batcher=self.status_batcher,
+            owns=self.opts.owns_fn,
+            distribute_singletons=self.opts.distribute_singletons)
         # The manager pumps watch through the SAME (chaos/informer-wrapped)
         # client the controllers read from — with the informer on, events
         # arrive via its post-cache-update relay, so a woken reconcile can

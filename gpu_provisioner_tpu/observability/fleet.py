@@ -134,6 +134,44 @@ class LatencyDigest:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def state(self) -> dict:
+        """Wire form for cross-process aggregation (the multi-process shard
+        supervisor ships these over the worker channel): the raw bucket
+        counts plus the scalar folds. Buckets are fixed module-wide, so a
+        snapshot is mergeable by element-wise add regardless of which
+        worker produced it."""
+        return {"counts": list(self.counts), "count": self.count,
+                "total": self.total, "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LatencyDigest":
+        d = cls()
+        counts = list(state.get("counts", ()))
+        # tolerate a peer built against a different ladder length rather
+        # than corrupting the merge — excess tail folds into the overflow
+        for i, c in enumerate(counts):
+            d.counts[min(i, len(d.counts) - 1)] += int(c)
+        d.count = int(state.get("count", 0))
+        d.total = float(state.get("total", 0.0))
+        d.min = float(state.get("min", 0.0))
+        d.max = float(state.get("max", 0.0))
+        return d
+
+    def merge(self, other: "LatencyDigest") -> None:
+        """Fold ``other`` into this digest: element-wise bucket add plus
+        scalar folds. Correct because every digest shares BUCKET_BOUNDS."""
+        if other.count == 0:
+            return
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        if self.count == 0:
+            self.min = other.min
+        else:
+            self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        self.count += other.count
+        self.total += other.total
+
     def summary(self) -> dict:
         return {
             "count": self.count,
@@ -345,3 +383,62 @@ class FleetAggregator:
                        for phase, d in sorted(self.phase_digests.items())},
             "objectives": [t.to_dict() for t in self.slos],
         }
+
+
+# ------------------------------------------------- cross-process aggregation
+
+def digest_states() -> dict:
+    """CUMULATIVE wire snapshot of every live aggregator in this process —
+    what a shard worker ships to the supervisor over the snapshot channel.
+    Keys are the joined label tuple (JSON has no tuple keys); digests from
+    multiple aggregators under the same key merge element-wise."""
+    digests: dict[str, LatencyDigest] = {}
+    claims = 0
+    for agg in list(AGGREGATORS):
+        claims += agg.claims_observed
+        for key, digest in list(agg.digests.items()):
+            k = "|".join(key)
+            if k in digests:
+                digests[k].merge(digest)
+            else:
+                d = LatencyDigest()
+                d.merge(digest)
+                digests[k] = d
+    return {"claims_observed": claims,
+            "digests": {k: d.state() for k, d in digests.items()}}
+
+
+class FleetMirror:
+    """Parent-side stand-in for the workers' aggregators: registered in
+    ``AGGREGATORS`` so the /metrics SLO export walks it like a local
+    aggregator, but its digests are rebuilt WHOLESALE from the latest
+    per-worker cumulative snapshots on every :meth:`load` — replacing, not
+    folding into, prior state, so re-delivered snapshots never double-count.
+    The holder (ShardSupervisor) keeps the strong reference; the weak
+    registry drops the mirror with it."""
+
+    def __init__(self) -> None:
+        self.digests: dict[tuple[str, str, str, str], LatencyDigest] = {}
+        self.claims_observed = 0
+        # present (empty) so the /metrics AGGREGATORS walk treats a mirror
+        # exactly like a local aggregator; phase/SLO state stays worker-local
+        self.phase_digests: dict[str, LatencyDigest] = {}
+        self.slos: tuple = ()
+        AGGREGATORS.add(self)
+
+    def load(self, worker_states) -> None:
+        digests: dict[tuple[str, str, str, str], LatencyDigest] = {}
+        claims = 0
+        for st in worker_states:
+            if not st:
+                continue
+            claims += int(st.get("claims_observed", 0))
+            for k, ds in st.get("digests", {}).items():
+                key = tuple(k.split("|"))
+                nd = LatencyDigest.from_state(ds)
+                if key in digests:
+                    digests[key].merge(nd)
+                else:
+                    digests[key] = nd
+        self.digests = digests
+        self.claims_observed = claims

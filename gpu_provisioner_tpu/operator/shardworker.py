@@ -1,0 +1,251 @@
+"""Shard worker process: one lease-owned slice of the fleet, end to end.
+
+``python -m gpu_provisioner_tpu.operator.shardworker --socket S --identity w0``
+boots a full operator stack (controllers, informer cache, workqueues, wake
+hub, status batcher, operation tracker — the whole envtest Env) in its OWN
+process, against the parent supervisor's store and fake cloud through the
+shard IPC socket (runtime/shardipc.py):
+
+- claim ownership comes from the **lease table** (runtime/shardlease.py):
+  the worker leases claim ranges through the same (remote) kube client its
+  controllers use, targets ``ceil(ranges / target_workers)``, and hands the
+  registry the live ``table.owns`` predicate — dequeue fences, map-fn
+  filters and the distributed singletons (GC / recovery / slice-group) all
+  read the table's current holdings;
+- the informer relay is **shared-nothing**: the server filters this
+  worker's NodeClaim/Node watch streams and full-scan lists to its leased
+  ranges, so the worker caches only its slice of the fleet. Lease handoffs
+  arrive as replayed ADDED / synthesized DELETED events;
+- wakes for foreign claims are **forwarded, not delivered**: the hub's
+  ``route`` hook posts a wake frame and the server re-delivers it to the
+  owning worker, carrying the original wake source across the process
+  boundary.
+
+This module is operator composition-root code (L5) on the worker side —
+the cloud proxies live here, not in runtime/shardipc.py, so the runtime
+layer stays cloud-neutral (provgraph PG001).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import json
+import logging
+import signal
+from typing import Optional
+
+from ..apis.serde import from_dict as serde_from_dict, to_dict as serde_to_dict
+from ..envtest import Env, EnvtestOptions
+from ..observability.fleet import digest_states
+from ..providers.gcp import (
+    APIError, CompletedOperation, NodePool, QueuedResource,
+)
+from ..runtime.shardipc import RemoteError, SocketClient
+from ..runtime.shardlease import ShardLeaseTable
+from ..runtime.wakehub import WAKES
+
+log = logging.getLogger("shardworker")
+
+# Cadence of the cumulative stats snapshot pushed to the supervisor (the
+# parent's /metrics fold and the bench's imbalance sampling read these).
+SNAP_INTERVAL = 0.2
+
+
+# ------------------------------------------------------------- cloud proxies
+
+class _RemoteAPI:
+    def __init__(self, ipc: SocketClient):
+        self._ipc = ipc
+
+    async def _call(self, op: str, **args):
+        try:
+            return await self._ipc.call(op, **args)
+        except RemoteError as e:
+            if e.cls_name == "APIError":
+                # re-raise the provider taxonomy: code carries 404/409/429
+                raise APIError(str(e), code=e.extra.get("code", 500)) \
+                    from None
+            raise
+
+
+class RemoteNodePoolsAPI(_RemoteAPI):
+    """The 4-method NodePoolsAPI seam over the shard socket. ``begin_*``
+    execute on the server (the fake cloud's server-side LRO ledger keeps
+    driving them whether or not this worker survives) and return an
+    already-complete operation — workers run the non-blocking tracker path
+    (``blocking_create=False``), which resolves creates/deletes against
+    batched ``list()`` polls, never against the returned operation."""
+
+    async def begin_create(self, pool: NodePool):
+        await self._call("cloud.np.begin_create", pool=pool.to_dict())
+        return CompletedOperation(None)
+
+    async def get(self, name: str) -> NodePool:
+        return NodePool.from_dict(await self._call("cloud.np.get", name=name))
+
+    async def begin_delete(self, name: str):
+        await self._call("cloud.np.begin_delete", name=name)
+        return CompletedOperation(None)
+
+    async def list(self) -> list[NodePool]:
+        return [NodePool.from_dict(d)
+                for d in await self._call("cloud.np.list")]
+
+
+class RemoteQueuedResourcesAPI(_RemoteAPI):
+    async def create(self, qr: QueuedResource) -> QueuedResource:
+        return serde_from_dict(QueuedResource, await self._call(
+            "cloud.qr.create", qr=serde_to_dict(qr)))
+
+    async def get(self, name: str) -> QueuedResource:
+        return serde_from_dict(
+            QueuedResource, await self._call("cloud.qr.get", name=name))
+
+    async def delete(self, name: str) -> None:
+        await self._call("cloud.qr.delete", name=name)
+
+    async def list(self) -> list[QueuedResource]:
+        return [serde_from_dict(QueuedResource, d)
+                for d in await self._call("cloud.qr.list")]
+
+
+class RemoteCloud:
+    """Duck-typed FakeCloud stand-in: just the two API seams the provider
+    stack consumes. No chaos — fault injection stays parent-side, where the
+    real cloud state lives."""
+
+    def __init__(self, ipc: SocketClient):
+        self.nodepools = RemoteNodePoolsAPI(ipc)
+        self.queuedresources = RemoteQueuedResourcesAPI(ipc)
+        self.chaos = None
+
+
+# ---------------------------------------------------------------- the worker
+
+def _build_options(overrides: Optional[dict]) -> EnvtestOptions:
+    opts = EnvtestOptions()
+    # worker-process defaults: informer ON (the relay feeds it), runtime
+    # detectors OFF (a subprocess sharing one contended host with N siblings
+    # trips wall-clock stall sentinels on scheduler noise, not loop abuse)
+    opts.use_informer = True
+    opts.stall_budget = 0.0
+    opts.leak_check = False
+    opts.flight_recorder = False
+    for key, value in (overrides or {}).items():
+        # dotted keys reach nested option dataclasses over the JSON seam:
+        # "lifecycle.status_flush_window" → opts.lifecycle.status_flush_window
+        target, *path, leaf = [opts, *key.split(".")]
+        for part in path:
+            target = getattr(target, part, None)
+            if target is None:
+                raise SystemExit(f"unknown EnvtestOptions path {key!r}")
+        if not hasattr(target, leaf):
+            raise SystemExit(f"unknown EnvtestOptions field {key!r}")
+        setattr(target, leaf, value)
+    return opts
+
+
+def snapshot(env: Env, table: ShardLeaseTable) -> dict:
+    """The cumulative stats frame pushed to the supervisor: wake ledger,
+    queue depths, fleet digest states, lease + batcher counters. Everything
+    cumulative-or-gauge so a re-delivered snapshot never double-counts."""
+    controllers = env.manager.controllers
+    data = {
+        "wakes": dict(WAKES),
+        "depths": {c.name: c.queue.depth() for c in controllers},
+        "hub": {"delivered": env.wakehub.delivered_total,
+                "forwarded": env.wakehub.forwarded_total},
+        "disowned": {c.name: c.disowned_total for c in controllers
+                     if getattr(c, "disowned_total", 0)},
+        "lease": {"ranges": sorted(table.ranges),
+                  "acquired": table.acquired_total,
+                  "released": table.released_total,
+                  "adopted": table.adopted_total},
+        "fleet": digest_states(),
+    }
+    if env.status_batcher is not None:
+        data["batcher"] = {"submitted": env.status_batcher.submitted,
+                           "coalesced": env.status_batcher.coalesced}
+    return data
+
+
+async def run_worker(socket_path: str, identity: str, target: int,
+                     overrides: Optional[dict] = None,
+                     lease_duration: Optional[float] = None,
+                     renew_interval: Optional[float] = None) -> None:
+    client = await SocketClient.connect(socket_path, identity=identity)
+    lease_kw = {}
+    if lease_duration is not None:
+        lease_kw["lease_duration"] = lease_duration
+    if renew_interval is not None:
+        lease_kw["renew_interval"] = renew_interval
+    table = ShardLeaseTable(
+        client, identity=identity, target_workers=target,
+        on_change=lambda gained, lost: client.send_ranges(table.ranges),
+        **lease_kw)
+    # boot order matters: acquire leases and announce the range set FIRST,
+    # so the informer's initial lists/watch replays (opened by Env startup
+    # below) are filtered to this worker's slice from the first event
+    await table.start()
+    client.send_ranges(table.ranges)
+
+    opts = _build_options(overrides)
+    opts.owns_fn = table.owns
+    opts.distribute_singletons = True
+    opts.shards, opts.shard_index = 1, 0
+    env = Env(opts, client=client, cloud=RemoteCloud(client))
+
+    def route(name: str, source: str) -> bool:
+        if table.owns(name):
+            return False  # ours: deliver locally
+        client.send_wake(name, source)
+        return True
+    env.wakehub.route = route
+
+    stop = asyncio.Event()
+    client.on_wake = lambda name, source: env.wakehub.wake_after(
+        name, 0.0, source)
+    client.on_target = table.set_target_workers
+    client.on_stop = stop.set
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+
+    async with env:
+        log.info("worker %s up: %d ranges", identity, len(table.ranges))
+        while not stop.is_set():
+            client.send_snap(snapshot(env, table))
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(stop.wait(), timeout=SNAP_INTERVAL)
+    # graceful exit: final cumulative snapshot, release leases so peers
+    # adopt without waiting out the expiry, then drop the pipe
+    client.send_snap(snapshot(env, table))
+    await table.stop(release=True)
+    await client.close()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="shard worker process")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--identity", required=True)
+    p.add_argument("--target", type=int, default=1,
+                   help="initial worker-count target (fair-share divisor)")
+    p.add_argument("--opts", default=None,
+                   help="JSON dict of scalar EnvtestOptions overrides")
+    p.add_argument("--lease-duration", type=float, default=None)
+    p.add_argument("--renew-interval", type=float, default=None)
+    p.add_argument("--log-level", default="WARNING")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+    overrides = json.loads(args.opts) if args.opts else None
+    asyncio.run(run_worker(args.socket, args.identity, args.target,
+                           overrides=overrides,
+                           lease_duration=args.lease_duration,
+                           renew_interval=args.renew_interval))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,273 @@
+"""ShardSupervisor: lease-owned worker processes over one store + cloud.
+
+The parent process owns the authoritative state (the in-memory kube store
+and the fake cloud's ledgers) and serves it over the shard IPC socket
+(runtime/shardipc.py). Each shard is a real OS process
+(operator/shardworker.py) running its own event loop, workqueues, wake hub
+and informer cache over its **leased claim ranges** — breaking the
+single-event-loop ceiling the in-process shard benches hit (BENCH_pr11:
+10k-claim wall RISES with in-process shard count because every shard's
+controllers contend for one loop).
+
+Scaling is lease handoff, not restart: ``scale(n)`` pushes the new target
+to every worker; over-share workers release ranges, under-share workers
+acquire them, and nothing stops. A SIGKILLed worker's ranges expire and are
+adopted by survivors (``kill()`` exists precisely so tests can prove that).
+
+The supervisor also aggregates worker observability: each worker pushes a
+cumulative stats snapshot (wake ledger, queue depths, fleet digest states)
+every ``shardworker.SNAP_INTERVAL``; the /metrics scrape folds those via
+the ``shardipc.SERVERS`` registry, and the supervisor's
+:class:`~..observability.fleet.FleetMirror` merges worker latency digests
+into the fleet SLO export.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+from ..apis.core import Node
+from ..apis.serde import from_dict as serde_from_dict, to_dict as serde_to_dict
+from ..observability.fleet import FleetMirror
+from ..providers.gcp import NodePool, QueuedResource
+from ..runtime.shardipc import ShardIPCServer
+from ..runtime.shardlease import NUM_RANGES
+
+log = logging.getLogger("supervisor")
+
+
+def cloud_ops(cloud) -> dict:
+    """The ``cloud.*`` verb table served to workers: thin codecs over the
+    parent's fake cloud APIs. ``begin_*`` drop the returned operation — the
+    fake's server-side LRO ledger keeps driving it, and workers resolve
+    outcomes from tracker-batched ``list`` polls (which also settle overdue
+    operations on every call, crash-restart realism included)."""
+    np, qr = cloud.nodepools, cloud.queuedresources
+
+    async def np_begin_create(a):
+        await np.begin_create(NodePool.from_dict(a["pool"]))
+        return None
+
+    async def np_get(a):
+        return (await np.get(a["name"])).to_dict()
+
+    async def np_begin_delete(a):
+        await np.begin_delete(a["name"])
+        return None
+
+    async def np_list(a):
+        return [p.to_dict() for p in await np.list()]
+
+    async def qr_create(a):
+        created = await qr.create(serde_from_dict(QueuedResource, a["qr"]))
+        return serde_to_dict(created)
+
+    async def qr_get(a):
+        return serde_to_dict(await qr.get(a["name"]))
+
+    async def qr_delete(a):
+        await qr.delete(a["name"])
+        return None
+
+    async def qr_list(a):
+        return [serde_to_dict(q) for q in await qr.list()]
+
+    return {
+        "cloud.np.begin_create": np_begin_create,
+        "cloud.np.get": np_get,
+        "cloud.np.begin_delete": np_begin_delete,
+        "cloud.np.list": np_list,
+        "cloud.qr.create": qr_create,
+        "cloud.qr.get": qr_get,
+        "cloud.qr.delete": qr_delete,
+        "cloud.qr.list": qr_list,
+    }
+
+
+class ShardSupervisor:
+    """Spawns, scales and reaps shard worker processes.
+
+    ``worker_opts`` is a dict of scalar EnvtestOptions overrides shipped to
+    every worker (timing knobs — the cloud itself lives parent-side).
+    ``lease_duration``/``renew_interval`` tune the ownership table's expiry
+    window (how long a SIGKILLed worker's ranges stay orphaned).
+    """
+
+    def __init__(self, client, cloud,
+                 worker_opts: Optional[dict] = None,
+                 num_ranges: int = NUM_RANGES,
+                 lease_duration: Optional[float] = None,
+                 renew_interval: Optional[float] = None,
+                 socket_path: Optional[str] = None):
+        self.client = client
+        self.cloud = cloud
+        self.worker_opts = dict(worker_opts or {})
+        self.num_ranges = num_ranges
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.socket_path = socket_path
+        self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
+        self.server = ShardIPCServer(client, num_ranges=num_ranges,
+                                     extra_ops=cloud_ops(cloud))
+        self.server.on_snap = self._on_snap
+        # parent-side stand-in for worker aggregators in the SLO export
+        self.mirror = FleetMirror()
+        self.procs: dict[str, asyncio.subprocess.Process] = {}
+        self.target = 0
+        self._spawned = 0
+        # index lists (spec.providerID lookups) arrive over IPC and execute
+        # against the parent store — register the index the way Env does
+        store = getattr(client, "store", None)
+        if store is not None:
+            store.add_index(Node, "spec.providerID",
+                            lambda o: [o.spec.provider_id])
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self.socket_path is None:
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="shardipc-")
+            self.socket_path = os.path.join(self._tmpdir.name, "shard.sock")
+        await self.server.start(self.socket_path)
+
+    async def stop(self, timeout: float = 10.0) -> None:
+        self.server.broadcast_stop()
+        for ident, proc in list(self.procs.items()):
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=timeout)
+            except asyncio.TimeoutError:
+                log.warning("worker %s ignored stop; killing", ident)
+                with _suppress_proc_errors():
+                    proc.kill()
+                await proc.wait()
+        self.procs.clear()
+        await self.server.stop()
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+            self._tmpdir = None
+
+    # -------------------------------------------------------------- scaling
+    async def spawn(self, n: int) -> None:
+        """Bring the fleet to ``n`` workers (initial launch or scale-up)."""
+        await self.scale(n)
+
+    async def scale(self, n: int) -> None:
+        """Rebalance to ``n`` workers WITHOUT a stop: new workers acquire
+        released/free ranges; on shrink, retired workers release their
+        leases on the way out and survivors pick them up."""
+        self.target = n
+        while len(self.procs) < n:
+            await self._spawn_worker()
+        excess = sorted(self.procs)[n:]
+        for ident in excess:
+            self._stop_worker(ident)
+        for ident in excess:
+            proc = self.procs.pop(ident)
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=10.0)
+            except asyncio.TimeoutError:
+                with _suppress_proc_errors():
+                    proc.kill()
+                await proc.wait()
+            self.server.snapshots.pop(ident, None)
+        self.server.broadcast_target(max(1, n))
+
+    async def _spawn_worker(self) -> None:
+        ident = f"w{self._spawned}"
+        self._spawned += 1
+        pkg_root = Path(__file__).resolve().parents[2]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (str(pkg_root) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, "-m",
+               "gpu_provisioner_tpu.operator.shardworker",
+               "--socket", self.socket_path, "--identity", ident,
+               "--target", str(max(1, self.target))]
+        if self.worker_opts:
+            cmd += ["--opts", json.dumps(self.worker_opts)]
+        if self.lease_duration is not None:
+            cmd += ["--lease-duration", str(self.lease_duration)]
+        if self.renew_interval is not None:
+            cmd += ["--renew-interval", str(self.renew_interval)]
+        self.procs[ident] = await asyncio.create_subprocess_exec(
+            *cmd, env=env)
+
+    def _stop_worker(self, ident: str) -> None:
+        for conn in self.server.conns:
+            if conn.worker == ident:
+                conn.post({"push": "stop"})
+                return
+        # never connected (or already gone): signal the process directly
+        proc = self.procs.get(ident)
+        if proc is not None:
+            with _suppress_proc_errors():
+                proc.send_signal(signal.SIGTERM)
+
+    def kill(self, ident: str, sig: int = signal.SIGKILL) -> None:
+        """Hard-kill a worker (crash-matrix harness): no lease release, no
+        final snapshot — its ranges expire and survivors adopt them."""
+        proc = self.procs.get(ident)
+        if proc is None:
+            raise KeyError(f"no worker {ident!r}")
+        with _suppress_proc_errors():
+            proc.send_signal(sig)
+
+    async def reap(self, ident: str, timeout: float = 10.0) -> None:
+        """Collect a dead worker and shrink the fair-share target so the
+        survivors' next lease tick adopts its expired ranges."""
+        proc = self.procs.pop(ident, None)
+        if proc is not None:
+            await asyncio.wait_for(proc.wait(), timeout=timeout)
+        self.server.snapshots.pop(ident, None)
+        self.target = max(1, len(self.procs))
+        self.server.broadcast_target(self.target)
+
+    # ---------------------------------------------------------- introspection
+    async def wait_covered(self, timeout: float = 30.0,
+                           workers: Optional[int] = None) -> None:
+        """Block until every claim range is leased by a live connection
+        (and, optionally, at least ``workers`` connections exist) — the
+        boot/rebalance barrier tests and the bench sit on."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        want = set(range(self.num_ranges))
+        while True:
+            held: set[int] = set()
+            for conn in self.server.conns:
+                held |= conn.ranges
+            if held >= want and (workers is None
+                                 or len(self.server.conns) >= workers):
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                missing = sorted(want - held)
+                raise TimeoutError(
+                    f"ranges uncovered after {timeout}s: {missing[:8]}"
+                    f"{'...' if len(missing) > 8 else ''} "
+                    f"({len(self.server.conns)} workers connected)")
+            await asyncio.sleep(0.05)
+
+    def snapshots(self) -> dict[str, dict]:
+        return dict(self.server.snapshots)
+
+    def _on_snap(self, worker: str, data: dict) -> None:
+        self.mirror.load([s.get("fleet") for s in
+                          self.server.snapshots.values()])
+
+
+class _suppress_proc_errors:
+    """ProcessLookupError-tolerant signal delivery (the worker may have
+    exited between our bookkeeping and the signal)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return exc_type is not None and issubclass(exc_type,
+                                                   ProcessLookupError)

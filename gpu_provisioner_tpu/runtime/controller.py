@@ -22,7 +22,7 @@ from ..apis.meta import Object
 from . import probes
 from .client import Client
 from .store import WatchEvent
-from .wakehub import SOURCE_INJECT, SOURCE_WATCH
+from .wakehub import SOURCE_INJECT, SOURCE_WATCH, note_skipped_arm
 from .workqueue import RateLimitingQueue
 
 log = logging.getLogger("runtime.controller")
@@ -44,6 +44,17 @@ class Result:
     # forgets the counter and a persistently-failing create retries at a
     # fixed cadence forever instead of climbing the backoff ladder.
     preserve_failures: bool = False
+    # The event source expected to end this wait (wakehub.SOURCE_*). When
+    # the controller's hub has ANNOUNCED a live producer for it, the
+    # safety-net timer behind ``requeue_after`` is not armed at all (the
+    # timer diet): the wake lands through the hub, and the arm is recorded
+    # in the WAKES ledger under ``timer-arm-skipped``. None keeps the
+    # legacy always-arm behavior.
+    wake_source: Optional[str] = None
+    # A deadline that must survive the skip (e.g. the liveness budget
+    # folded under a shorter sourced park): armed INSTEAD of requeue_after
+    # when the sourced timer is skipped.
+    fallback_after: Optional[float] = None
 
 
 class Reconciler(Protocol):
@@ -118,6 +129,16 @@ class Controller:
         # assigned by the registry: which shard this controller instance
         # belongs to (labels the per-shard queue-depth gauge)
         self.shard_index = 0
+        # Dynamic range-ownership predicate (runtime/shardlease.py), set by
+        # the registry for claim-keyed controllers in lease-sharded workers:
+        # checked at DEQUEUE, so an item enqueued before a lease handoff is
+        # dropped — not reconciled — the moment this worker no longer owns
+        # its range. None (static sharding / single process) never drops.
+        self.owns: Optional[Callable[[str], bool]] = None
+        self.disowned_total = 0
+        # The WakeHub this controller's wake producers announce on; gates
+        # the Result.wake_source timer-arm skip. Assigned by the registry.
+        self.wake_hub = None
         self.queue = RateLimitingQueue()
         self.sources: list[_Source] = []
         self.singleton = False
@@ -241,6 +262,17 @@ class Controller:
                 await self.queue.forget(req)
                 await self.queue.done(req)
                 continue
+            if (self.owns is not None and not self.singleton
+                    and not self.owns(req.name)):
+                # Lease handoff window: the range moved to another worker
+                # between enqueue and dequeue. Drop like a fence would —
+                # the new owner's lease-gain replay re-drives the object,
+                # so reconciling here would double-write.
+                self.disowned_total += 1
+                probes.emit("disown-drop", req, controller=self.name)
+                await self.queue.forget(req)
+                await self.queue.done(req)
+                continue
             if self.governor is not None:
                 # AIMD pacing: free in HEALTHY mode; in degraded modes this
                 # is where the reconcile rate sheds. After the fence check
@@ -296,7 +328,23 @@ class Controller:
                         await self.queue.forget(req)
                     await self.queue.done(req)
                     if result and result.requeue_after is not None:
-                        await self.queue.add_after(req, result.requeue_after)
+                        # Timer diet: a park annotated with an ANNOUNCED
+                        # event source skips the safety-net arm entirely —
+                        # the producer wakes it through the hub. The skip
+                        # is ledgered (timer-arm-skipped) and any folded
+                        # un-sourced deadline (liveness budget) is armed in
+                        # the sourced timer's place.
+                        if (result.wake_source is not None
+                                and self.wake_hub is not None
+                                and self.wake_hub.announced(
+                                    result.wake_source)):
+                            note_skipped_arm()
+                            if result.fallback_after is not None:
+                                await self.queue.add_after(
+                                    req, result.fallback_after)
+                        else:
+                            await self.queue.add_after(req,
+                                                       result.requeue_after)
                     elif result and result.requeue:
                         await self.queue.add_rate_limited(req)
                 finally:

@@ -1,0 +1,674 @@
+"""Cross-process shard transport: NDJSON frames over a unix socket.
+
+The multi-process scale-out (operator/supervisor.py + operator/shardworker.py)
+keeps ONE kube store and ONE fake cloud in the parent process and runs each
+shard's controllers in its own OS process. This module is the wire between
+them, and only the wire — it knows nothing about controllers or clouds:
+
+- :class:`SocketClient` — the full runtime ``Client`` protocol proxied over
+  the socket, so a worker's controllers/informers/lease table run unchanged
+  against the parent's store. ``watch()`` returns a :class:`RemoteWatch`
+  with the same ``try_next()``/idempotent-``close()`` contract the in-process
+  ``Watch`` has, which is what the informer pump drains.
+- :class:`ShardIPCServer` — the parent side: per-request task dispatch (one
+  slow op never blocks the pipe), per-(conn, watch) store pumps, and the
+  **shared-nothing relay filter**: watch events and full-scan lists of the
+  claim-keyed kinds (NodeClaim, Node) are delivered to a worker only when
+  the object's routing ranges intersect the worker's leased ranges, so each
+  worker caches only its owned slice of the fleet. Label/index/namespace
+  lists pass through unfiltered — cross-range reads (a slice group's member
+  list) stay whole-fleet.
+- **Wake frames** — the cross-process extension of the WakeHub seam. A
+  worker that produces a wake for a claim it does not own posts a ``wake``
+  frame; the server routes it to the owning worker's connection by
+  ``range_of(name)`` (dropped when nothing owns the range — the lease-gain
+  ADDED replay re-drives adoption anyway). Frames carry the existing
+  sourced-wake vocabulary, so an LRO completion forwarded across processes
+  still lands as ``source=lro`` in the receiving worker's ledger.
+
+Relay ordering guarantee: per connection, events of one kind are written in
+store-commit order (one pump task per watch, one reader per conn). A lease
+handoff inserts a replay — ADDED for gained ranges, synthesized DELETED for
+lost ones — which can interleave with live events; consumers absorb that
+because informer upserts are idempotent and the dequeue-side ``owns`` fence
+drops foreign keys.
+
+Layering: runtime-only (provgraph PG001) — cloud proxies live with the
+worker composition root (operator/shardworker.py), wired through the
+server's ``extra_ops`` table here.
+
+Frame shapes (one JSON object per line):
+
+    {"id": 7, "op": "kube.get", "a": {...}}      request
+    {"re": 7, "ok": ...} | {"re": 7, "err": {...}}  response
+    {"push": "watch", "wid": 3, "t": "ADDED", "o": {...}}
+    {"push": "wake", "name": "...", "source": "lro"}
+    {"push": "ranges", "ranges": [0, 5, 9]}      worker → server
+    {"push": "snap", "data": {...}}              worker → server
+    {"push": "hello", "worker": "w0"}            worker → server
+    {"push": "target", "workers": 4}             server → worker
+    {"push": "stop"}                             server → worker
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import weakref
+from typing import Any, Callable, Optional
+
+from ..apis import labels as wk
+from ..apis.meta import Object, kind_for, object_from_manifest
+from . import probes
+from .client import (
+    AlreadyExistsError, ClientError, ConflictError, EvictionBlockedError,
+    NotFoundError, ResourceExpiredError, TooManyRequestsError,
+)
+from .shardlease import NUM_RANGES, range_of
+from .store import WatchEvent
+
+log = logging.getLogger("shardipc")
+
+# Per-frame stream buffer ceiling, both directions. A frame is one JSON
+# line; unfiltered full-scan lists (``kube.list`` of every NodeClaim at
+# 10k claims) are the big ones — asyncio's 64 KiB readline default
+# tears the connection down at a few hundred claims.
+FRAME_LIMIT = 64 * 1024 * 1024
+
+# Live servers, for the /metrics scrape fold (controllers/metrics.py walks
+# this the way it walks operations.TRACKERS): worker snapshots hang off the
+# server, and the weak set drops a supervisor's server with it.
+SERVERS: "weakref.WeakSet[ShardIPCServer]" = weakref.WeakSet()
+
+# Kinds the relay filters by claim-range ownership. Everything else
+# (Pod, Lease, Event, PDB, ...) is delivered whole-fleet: those kinds are
+# either coordination state every worker needs (Lease) or keyed by names
+# that do not partition with claims.
+FILTERED_KINDS = ("NodeClaim", "Node")
+
+_ERROR_CLASSES = {c.__name__: c for c in (
+    ClientError, NotFoundError, ConflictError, AlreadyExistsError,
+    EvictionBlockedError, ResourceExpiredError, TooManyRequestsError,
+)}
+
+
+class RemoteError(ClientError):
+    """A server-side error with no runtime-layer class (a cloud APIError,
+    an unexpected crash). Carries the original class name and extras so the
+    cloud proxies can re-raise their own taxonomy."""
+
+    def __init__(self, cls_name: str, message: str,
+                 extra: Optional[dict] = None):
+        super().__init__(message)
+        self.cls_name = cls_name
+        self.extra = extra or {}
+
+
+def wire_error(e: BaseException) -> dict:
+    d: dict[str, Any] = {"cls": type(e).__name__, "msg": str(e)}
+    code = getattr(e, "code", None)
+    if isinstance(code, int):
+        d["code"] = code
+    retry_after = getattr(e, "retry_after", None)
+    if isinstance(retry_after, (int, float)):
+        d["retryAfter"] = retry_after
+    return d
+
+
+def unwire_error(d: dict) -> Exception:
+    name, msg = d.get("cls", "ClientError"), d.get("msg", "")
+    cls = _ERROR_CLASSES.get(name)
+    if cls is TooManyRequestsError:
+        return cls(msg, retry_after=d.get("retryAfter", 0.0))
+    if cls is not None:
+        return cls(msg)
+    extra = {k: v for k, v in d.items() if k not in ("cls", "msg")}
+    return RemoteError(name, msg, extra)
+
+
+def routing_ranges(obj: Object, num_ranges: int = NUM_RANGES) -> set[int]:
+    """The claim ranges an object belongs to. A NodeClaim routes by its own
+    name (== pool name) and its slice group; a Node by the pool that owns it
+    (slice-id/gke-nodepool label, falling back to its own name) and the
+    group. Multi-key on purpose: the group's owning worker caches every
+    member slice, so cross-slice group reads stay local to it."""
+    labels = obj.metadata.labels
+    if obj.KIND == "NodeClaim":
+        keys = {obj.metadata.name}
+    else:  # Node
+        keys = {labels.get(wk.TPU_SLICE_ID_LABEL)
+                or labels.get(wk.GKE_NODEPOOL_LABEL)
+                or obj.metadata.name}
+    group = labels.get(wk.TPU_SLICE_GROUP_LABEL)
+    if group:
+        keys.add(group)
+    return {range_of(k, num_ranges) for k in keys}
+
+
+def _dump(frame: dict) -> bytes:
+    return json.dumps(frame, separators=(",", ":")).encode() + b"\n"
+
+
+# --------------------------------------------------------------- client side
+
+_W_CLOSED = object()
+
+
+class RemoteWatch:
+    """Client-side watch proxy: same surface as runtime.client.Watch
+    (async iterator + ``try_next`` burst drain + idempotent ``close``),
+    fed by the recv loop from ``watch`` push frames."""
+
+    def __init__(self, client: "SocketClient", wid: int):
+        self._client = client
+        self._wid = wid
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._closed:
+            raise StopAsyncIteration
+        ev = await self._q.get()
+        if ev is _W_CLOSED or self._closed:
+            raise StopAsyncIteration
+        return ev
+
+    def try_next(self) -> Optional[WatchEvent]:
+        if self._closed:
+            return None
+        try:
+            ev = self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if ev is _W_CLOSED:
+            return None
+        return ev
+
+    def _deliver(self, etype: str, manifest: dict) -> None:
+        self._q.put_nowait(WatchEvent(etype, object_from_manifest(manifest)))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._client._watches.pop(self._wid, None)
+        self._client._post({"push": "watch_close", "wid": self._wid})
+        self._q.put_nowait(_W_CLOSED)
+
+
+class SocketClient:
+    """The runtime ``Client`` protocol over the shard socket, plus the
+    worker-side push surface (wake out, ranges/snap out; wake/target/stop
+    in via the ``on_*`` callbacks, all sync — schedule, don't await)."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, identity: str = ""):
+        self._reader = reader
+        self._writer = writer
+        self.identity = identity
+        self._next_id = 0
+        self._next_wid = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, RemoteWatch] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        # sync callbacks, wired by the worker composition root
+        self.on_wake: Optional[Callable[[str, str], None]] = None
+        self.on_target: Optional[Callable[[int], None]] = None
+        self.on_stop: Optional[Callable[[], None]] = None
+
+    @classmethod
+    async def connect(cls, path: str, identity: str = "") -> "SocketClient":
+        reader, writer = await asyncio.open_unix_connection(
+            path, limit=FRAME_LIMIT)
+        client = cls(reader, writer, identity=identity)
+        client._post({"push": "hello", "worker": identity})
+        client._task = asyncio.create_task(
+            client._recv_loop(), name=f"shard-ipc-client/{identity}")
+        return client
+
+    # ------------------------------------------------------------ transport
+    def _post(self, frame: dict) -> None:
+        if self._closed:
+            return
+        self._writer.write(_dump(frame))
+
+    async def call(self, op: str, **args) -> Any:
+        if self._closed:
+            raise ClientError("shard IPC connection closed")
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[rid] = fut
+        self._post({"id": rid, "op": op, "a": args})
+        res = await fut
+        if "err" in res:
+            raise unwire_error(res["err"])
+        return res.get("ok")
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                self._dispatch(json.loads(line))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport death, not logic
+            log.warning("shard IPC recv loop failed: %s", e)
+        finally:
+            self._fail_pending()
+
+    def _dispatch(self, frame: dict) -> None:
+        rid = frame.get("re")
+        if rid is not None:
+            fut = self._pending.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(frame)
+            return
+        push = frame.get("push")
+        if push == "watch":
+            w = self._watches.get(frame["wid"])
+            if w is not None:
+                w._deliver(frame["t"], frame["o"])
+        elif push == "wake":
+            if self.on_wake is not None:
+                self.on_wake(frame["name"], frame["source"])
+        elif push == "target":
+            if self.on_target is not None:
+                self.on_target(frame["workers"])
+        elif push == "stop":
+            if self.on_stop is not None:
+                self.on_stop()
+
+    def _fail_pending(self) -> None:
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_result(
+                    {"err": {"cls": "ClientError",
+                             "msg": "shard IPC connection closed"}})
+        self._pending.clear()
+        for w in list(self._watches.values()):
+            w._q.put_nowait(_W_CLOSED)
+            w._closed = True
+        self._watches.clear()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self._fail_pending()
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except Exception:  # noqa: BLE001 — peer may already be gone
+            pass
+
+    # --------------------------------------------------------- push surface
+    def send_wake(self, name: str, source: str) -> None:
+        self._post({"push": "wake", "name": name, "source": source})
+
+    def send_ranges(self, ranges: set[int]) -> None:
+        self._post({"push": "ranges", "ranges": sorted(ranges)})
+
+    def send_snap(self, data: dict) -> None:
+        self._post({"push": "snap", "data": data})
+
+    # ------------------------------------------------------ Client protocol
+    async def get(self, cls: type, name: str, namespace: str = "") -> Object:
+        res = await self.call("kube.get", kind=cls.KIND, name=name,
+                              namespace=namespace)
+        return object_from_manifest(res)
+
+    async def list(self, cls: type, labels=None, namespace=None,
+                   index=None) -> list[Object]:
+        res = await self.call(
+            "kube.list", kind=cls.KIND, labels=labels, namespace=namespace,
+            index=list(index) if index is not None else None)
+        return [object_from_manifest(m) for m in res]
+
+    async def create(self, obj: Object) -> Object:
+        return object_from_manifest(
+            await self.call("kube.create", obj=obj.to_dict()))
+
+    async def update(self, obj: Object) -> Object:
+        return object_from_manifest(
+            await self.call("kube.update", obj=obj.to_dict()))
+
+    async def update_status(self, obj: Object) -> Object:
+        return object_from_manifest(
+            await self.call("kube.update_status", obj=obj.to_dict()))
+
+    async def delete(self, cls: type, name: str, namespace: str = "") -> None:
+        await self.call("kube.delete", kind=cls.KIND, name=name,
+                        namespace=namespace)
+
+    async def evict(self, name: str, namespace: str = "",
+                    uid: str = "") -> None:
+        await self.call("kube.evict", name=name, namespace=namespace, uid=uid)
+
+    def watch(self, cls: type) -> RemoteWatch:
+        self._next_wid += 1
+        wid = self._next_wid
+        w = RemoteWatch(self, wid)
+        self._watches[wid] = w
+        self._post({"push": "watch_open", "wid": wid, "kind": cls.KIND})
+        return w
+
+
+# --------------------------------------------------------------- server side
+
+class _Conn:
+    """One worker connection: its leased ranges, its open watch pumps, and
+    the latest snapshot it pushed."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.worker = ""
+        self.ranges: set[int] = set()
+        # wid -> (cls, Watch, pump task)
+        self.watches: dict[int, tuple] = {}
+        self.tasks: set[asyncio.Task] = set()
+        self.closed = False
+
+    def post(self, frame: dict) -> None:
+        if self.closed:
+            return
+        try:
+            self.writer.write(_dump(frame))
+        except Exception:  # noqa: BLE001 — dying conn; reader loop reaps it
+            self.closed = True
+
+
+class ShardIPCServer:
+    """The parent-process end of the shard transport.
+
+    ``client`` is the authoritative kube client (the parent's
+    InMemoryClient). ``extra_ops`` extends the verb table — the supervisor
+    registers the cloud proxies there (``cloud.np.*`` / ``cloud.qr.*``) so
+    this module stays runtime-layer. Handlers are
+    ``async fn(args: dict) -> jsonable``.
+    """
+
+    def __init__(self, client, num_ranges: int = NUM_RANGES,
+                 extra_ops: Optional[dict[str, Callable]] = None):
+        self.client = client
+        self.num_ranges = num_ranges
+        self.extra_ops = dict(extra_ops or {})
+        self.conns: list[_Conn] = []
+        # worker identity -> latest snap payload (wake ledger, queue depths,
+        # digest states, ...), read by the supervisor's metrics fold.
+        self.snapshots: dict[str, dict] = {}
+        self.wakes_routed = 0
+        self.wakes_dropped = 0
+        # optional sync hook fired on every snapshot push: (worker, data).
+        # The supervisor hangs its fleet-digest mirror refresh off it.
+        self.on_snap: Optional[Callable[[str, dict], None]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        SERVERS.add(self)
+
+    async def start(self, path: str) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._serve, path=path, limit=FRAME_LIMIT)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for conn in list(self.conns):
+            self._drop_conn(conn)
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        self._conn_tasks.clear()
+
+    # ------------------------------------------------------------- topology
+    def broadcast_target(self, workers: int) -> None:
+        """Push the new worker-count target; each worker's lease table
+        rebalances toward ceil(ranges/target) on its next tick."""
+        for conn in self.conns:
+            conn.post({"push": "target", "workers": workers})
+
+    def broadcast_stop(self) -> None:
+        for conn in self.conns:
+            conn.post({"push": "stop"})
+
+    def owner_of(self, name: str) -> Optional[_Conn]:
+        k = range_of(name, self.num_ranges)
+        for conn in self.conns:
+            if k in conn.ranges:
+                return conn
+        return None
+
+    def route_wake(self, name: str, source: str) -> bool:
+        """Deliver a wake frame to the worker owning ``name``'s range.
+        False (dropped) when no live worker owns it — safe: the range's
+        next lessee replays ADDED for everything in it, which re-drives
+        the reconcile the wake was for."""
+        conn = self.owner_of(name)
+        if conn is None:
+            self.wakes_dropped += 1
+            probes.emit("ipc-wake-dropped", name, source=source)
+            return False
+        conn.post({"push": "wake", "name": name, "source": source})
+        self.wakes_routed += 1
+        return True
+
+    # ------------------------------------------------------------ conn loop
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(writer)
+        self.conns.append(conn)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                except ValueError:
+                    log.warning("shard IPC: undecodable frame dropped")
+                    continue
+                self._dispatch(conn, frame)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one conn's death is local
+            log.warning("shard IPC conn %s failed: %s", conn.worker, e)
+        finally:
+            self._drop_conn(conn)
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        if conn in self.conns:
+            self.conns.remove(conn)
+        conn.closed = True
+        for cls, watch, task in conn.watches.values():
+            watch.close()
+            task.cancel()
+        conn.watches.clear()
+        for t in list(conn.tasks):
+            t.cancel()
+        try:
+            conn.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _dispatch(self, conn: _Conn, frame: dict) -> None:
+        """Push frames are handled inline (they are sync and ordering
+        matters: a ranges frame must take effect before a later watch_open
+        reads it); op requests fan out to per-request tasks so one slow op
+        never blocks the pipe."""
+        push = frame.get("push")
+        if push is not None:
+            handler = getattr(self, f"_push_{push}", None)
+            if handler is None:
+                log.warning("shard IPC: unknown push %r", push)
+                return
+            handler(conn, frame)
+            return
+        t = asyncio.create_task(self._handle_op(conn, frame))
+        conn.tasks.add(t)
+        t.add_done_callback(conn.tasks.discard)
+
+    # ---------------------------------------------------------- push frames
+    def _push_hello(self, conn: _Conn, frame: dict) -> None:
+        conn.worker = frame.get("worker", "")
+
+    def _push_ranges(self, conn: _Conn, frame: dict) -> None:
+        new = set(frame.get("ranges", ()))
+        gained, lost = new - conn.ranges, conn.ranges - new
+        conn.ranges = new
+        if gained or lost:
+            t = asyncio.create_task(self._replay(conn, gained, lost))
+            conn.tasks.add(t)
+            t.add_done_callback(conn.tasks.discard)
+
+    def _push_wake(self, conn: _Conn, frame: dict) -> None:
+        self.route_wake(frame["name"], frame["source"])
+
+    def _push_snap(self, conn: _Conn, frame: dict) -> None:
+        if not conn.worker:
+            return
+        self.snapshots[conn.worker] = frame.get("data", {})
+        if self.on_snap is not None:
+            try:
+                self.on_snap(conn.worker, self.snapshots[conn.worker])
+            except Exception:  # noqa: BLE001 — observability-grade hook
+                log.warning("on_snap hook failed", exc_info=True)
+
+    def _push_watch_open(self, conn: _Conn, frame: dict) -> None:
+        cls = kind_for(frame["kind"])
+        wid = frame["wid"]
+        watch = self.client.watch(cls)
+        task = asyncio.create_task(
+            self._pump(conn, wid, cls, watch),
+            name=f"shard-ipc-pump/{conn.worker}/{cls.KIND}")
+        conn.watches[wid] = (cls, watch, task)
+
+    def _push_watch_close(self, conn: _Conn, frame: dict) -> None:
+        entry = conn.watches.pop(frame["wid"], None)
+        if entry is not None:
+            cls, watch, task = entry
+            watch.close()
+            task.cancel()
+
+    # --------------------------------------------------------- watch relay
+    def _passes(self, conn: _Conn, obj: Object) -> bool:
+        if obj.KIND not in FILTERED_KINDS:
+            return True
+        return bool(routing_ranges(obj, self.num_ranges) & conn.ranges)
+
+    async def _pump(self, conn: _Conn, wid: int, cls: type, watch) -> None:
+        try:
+            async for ev in watch:
+                if not self._passes(conn, ev.object):
+                    continue
+                conn.post({"push": "watch", "wid": wid, "t": ev.type,
+                           "o": ev.object.to_dict()})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — conn teardown races
+            if not conn.closed:
+                log.warning("shard IPC pump %s/%s failed: %s",
+                            conn.worker, cls.KIND, e)
+
+    async def _replay(self, conn: _Conn, gained: set[int],
+                      lost: set[int]) -> None:
+        """Lease-handoff resync over every open watch of a filtered kind:
+        ADDED for objects entering the worker's view (the adoption
+        re-drive), synthesized DELETED for objects leaving it (the worker's
+        informer tombstones them; live events for those keys stop at the
+        relay filter)."""
+        for wid, (cls, watch, task) in list(conn.watches.items()):
+            if cls.KIND not in FILTERED_KINDS:
+                continue
+            try:
+                objs = await self.client.list(cls)
+            except Exception as e:  # noqa: BLE001 — next tick re-replays
+                log.warning("shard IPC replay list %s failed: %s",
+                            cls.KIND, e)
+                continue
+            for obj in objs:
+                rr = routing_ranges(obj, self.num_ranges)
+                if rr & gained:
+                    conn.post({"push": "watch", "wid": wid, "t": "ADDED",
+                               "o": obj.to_dict()})
+                elif rr & lost and not rr & conn.ranges:
+                    conn.post({"push": "watch", "wid": wid, "t": "DELETED",
+                               "o": obj.to_dict()})
+
+    # ------------------------------------------------------------- requests
+    async def _handle_op(self, conn: _Conn, frame: dict) -> None:
+        rid, op, args = frame.get("id"), frame.get("op", ""), frame.get("a", {})
+        try:
+            fn = self.extra_ops.get(op)
+            if fn is not None:
+                result = await fn(args)
+            else:
+                handler = getattr(self, "_op_" + op.replace(".", "_"), None)
+                if handler is None:
+                    raise ClientError(f"unknown op {op!r}")
+                result = await handler(conn, args)
+            conn.post({"re": rid, "ok": result})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — errors travel the wire
+            conn.post({"re": rid, "err": wire_error(e)})
+
+    async def _op_kube_get(self, conn, a):
+        obj = await self.client.get(kind_for(a["kind"]), a["name"],
+                                    a.get("namespace", ""))
+        return obj.to_dict()
+
+    async def _op_kube_list(self, conn, a):
+        kind = a["kind"]
+        labels, namespace, index = a.get("labels"), a.get("namespace"), \
+            a.get("index")
+        objs = await self.client.list(
+            kind_for(kind), labels, namespace,
+            tuple(index) if index is not None else None)
+        # Range-filter ONLY the full scans of claim-keyed kinds (same filter
+        # the watch relay applies, so a worker's informer initial list and
+        # its watch stream agree). Label/index/namespace lists stay
+        # whole-fleet: cross-range reads (slice-group membership, providerID
+        # lookups) must see everything.
+        if (kind in FILTERED_KINDS and labels is None and index is None
+                and namespace is None):
+            objs = [o for o in objs
+                    if routing_ranges(o, self.num_ranges) & conn.ranges]
+        return [o.to_dict() for o in objs]
+
+    async def _op_kube_create(self, conn, a):
+        return (await self.client.create(
+            object_from_manifest(a["obj"]))).to_dict()
+
+    async def _op_kube_update(self, conn, a):
+        return (await self.client.update(
+            object_from_manifest(a["obj"]))).to_dict()
+
+    async def _op_kube_update_status(self, conn, a):
+        return (await self.client.update_status(
+            object_from_manifest(a["obj"]))).to_dict()
+
+    async def _op_kube_delete(self, conn, a):
+        await self.client.delete(kind_for(a["kind"]), a["name"],
+                                 a.get("namespace", ""))
+        return None
+
+    async def _op_kube_evict(self, conn, a):
+        await self.client.evict(a["name"], a.get("namespace", ""),
+                                a.get("uid", ""))
+        return None

@@ -1,0 +1,299 @@
+"""Lease-based claim-range ownership for multi-process shard workers.
+
+The static crc32 partition (controllers/utils.shard_owns) pins claims to
+shard INDEXES for a process's lifetime — changing the shard count means a
+stop, and a dead shard's claims are orphaned until restart. This module
+replaces the partition key's codomain with a fixed set of NUM_RANGES small
+ranges, each owned through a coordination.k8s.io Lease object
+(``shard-range-<k>``) renewed exactly like leader election
+(runtime/leaderelection.py): ``range_of(name)`` is stable forever, but the
+range→worker mapping is leases, so
+
+- shard-count changes rebalance by lease handoff WITHOUT a stop: each
+  worker targets ``ceil(live_ranges / target_workers)`` ranges, releasing
+  excess leases for under-provisioned peers to pick up;
+- a SIGKILLed worker's ranges expire (``lease_duration``) and are adopted
+  by survivors — reclaimed, not orphaned;
+- the handoff window is fenced at DEQUEUE (Controller.owns) and at the
+  provider's mutation fence, so an in-flight enqueue from the losing
+  worker drops instead of double-reconciling.
+
+The table is deliberately client-agnostic: workers CRUD Lease objects over
+the same (possibly remote — runtime/shardipc.SocketClient) kube client the
+controllers use, so lease CAS safety is the store's resourceVersion
+conflict detection end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import zlib
+from typing import Callable, Iterable, Optional
+
+from ..apis.core import Lease, LeaseSpec
+from ..apis.meta import ObjectMeta
+from ..apis.serde import now
+from .client import (
+    AlreadyExistsError, Client, ConflictError, NotFoundError,
+)
+from .leaderelection import default_identity
+
+log = logging.getLogger("shardlease")
+
+# Fixed range count — the partition codomain. Small enough that the lease
+# table is a handful of tiny objects, large enough that ceil-fair-share
+# imbalance across any realistic worker count stays ≤ 2x (64 ranges / 8
+# workers = 8 each, exactly).
+NUM_RANGES = 64
+
+LEASE_PREFIX = "shard-range-"
+LEASE_NAMESPACE = "kube-system"
+
+# envtest-scale defaults; production would use leaderelection's 15/10/2.
+LEASE_DURATION = 2.0
+RENEW_INTERVAL = 0.5
+
+
+def range_of(name: str, num_ranges: int = NUM_RANGES) -> int:
+    """The stable range a claim/pool/group name hashes into. Same crc32 the
+    static partition used, different codomain — ownership moves by moving
+    the range's lease, never by rehashing."""
+    return zlib.crc32(name.encode()) % num_ranges
+
+
+class ShardLeaseTable:
+    """One worker's view of the range-lease table.
+
+    ``start()`` runs the acquire/renew loop: renew held leases, release
+    excess above the fair share, acquire free/expired leases up to it.
+    ``owns(name)`` is the O(1) predicate handed to the registry;
+    ``on_change(gained, lost)`` fires with range-id sets whenever holdings
+    move — the shard worker uses it to update its relay subscription (which
+    replays ADDED for gained ranges: the handoff resync that drives
+    adoption reconciles).
+    """
+
+    def __init__(self, client: Client, identity: Optional[str] = None,
+                 num_ranges: int = NUM_RANGES,
+                 target_workers: int = 1,
+                 lease_duration: float = LEASE_DURATION,
+                 renew_interval: float = RENEW_INTERVAL,
+                 namespace: str = LEASE_NAMESPACE,
+                 on_change: Optional[
+                     Callable[[set, set], None]] = None):
+        self.client = client
+        self.identity = identity or default_identity()
+        self.num_ranges = num_ranges
+        self.target_workers = max(1, target_workers)
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.namespace = namespace
+        self.on_change = on_change
+        self.ranges: set[int] = set()
+        self._task: Optional[asyncio.Task] = None
+        # (holder, renew_time) last observed per foreign range + local
+        # monotonic observation time — leaderelection's clock-skew guard.
+        self._observed: dict[int, tuple[tuple, float]] = {}
+        self.acquired_total = 0
+        self.released_total = 0
+        self.adopted_total = 0  # acquired from an EXPIRED foreign holder
+
+    # ------------------------------------------------------------ predicate
+    def owns(self, name: str) -> bool:
+        return range_of(name, self.num_ranges) in self.ranges
+
+    def fair_share(self) -> int:
+        return math.ceil(self.num_ranges / self.target_workers)
+
+    def set_target_workers(self, n: int) -> None:
+        """Topology push from the supervisor: the next tick rebalances
+        toward the new fair share (release on shrink of share, acquire on
+        growth) — no stop, no rehash."""
+        self.target_workers = max(1, n)
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self._task is not None:
+            return
+        await self.tick()  # acquire synchronously so boot has a range set
+        self._task = asyncio.create_task(self._loop(),
+                                         name="shard-lease-table")
+
+    async def stop(self, release: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if release and self.ranges:
+            for k in sorted(self.ranges):
+                await self._release(k)
+            self._apply_holdings(set())
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.renew_interval)
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the table must keep
+                log.warning("shard-lease tick failed: %s", e)  # renewing
+
+    # ------------------------------------------------------------ mechanics
+    def _lease_name(self, k: int) -> str:
+        return f"{LEASE_PREFIX}{k}"
+
+    def _expired(self, k: int, lease: Lease) -> bool:
+        if lease.spec.renew_time is None:
+            return True
+        age = (now() - lease.spec.renew_time).total_seconds()
+        if age > self.lease_duration:
+            return True
+        key = (lease.spec.holder_identity, lease.spec.renew_time)
+        mono = asyncio.get_event_loop().time()
+        seen = self._observed.get(k)
+        if seen is None or seen[0] != key:
+            self._observed[k] = (key, mono)
+            return False
+        return mono - seen[1] > self.lease_duration
+
+    async def tick(self) -> None:
+        """One renew/rebalance pass. Listing the whole table is one small
+        LIST (NUM_RANGES tiny objects); every mutation is resourceVersion
+        CAS, so two workers racing for the same range lose cleanly."""
+        leases: dict[int, Lease] = {}
+        for lease in await self.client.list(Lease,
+                                            namespace=self.namespace):
+            name = lease.metadata.name
+            if not name.startswith(LEASE_PREFIX):
+                continue
+            try:
+                leases[int(name[len(LEASE_PREFIX):])] = lease
+            except ValueError:
+                continue
+        held = set(self.ranges)
+        share = self.fair_share()
+
+        # 1. renew what we hold (lost CAS = lost range, accept immediately)
+        for k in sorted(held):
+            lease = leases.get(k)
+            if lease is None or lease.spec.holder_identity != self.identity:
+                held.discard(k)
+                continue
+            lease.spec.renew_time = now()
+            try:
+                leases[k] = await self.client.update(lease)
+            except (ConflictError, NotFoundError):
+                held.discard(k)
+
+        # 2. release excess above the fair share (shrink path of a
+        # topology change): highest ranges first, deterministic, so two
+        # over-provisioned workers don't thrash the same range.
+        while len(held) > share:
+            k = max(held)
+            await self._release(k, leases.get(k))
+            held.discard(k)
+
+        # 3. acquire free/expired ranges up to the share
+        if len(held) < share:
+            for k in range(self.num_ranges):
+                if len(held) >= share:
+                    break
+                if k in held:
+                    continue
+                lease = leases.get(k)
+                if lease is None:
+                    if await self._create(k):
+                        held.add(k)
+                        self.acquired_total += 1
+                    continue
+                if lease.spec.holder_identity == self.identity:
+                    held.add(k)
+                    continue
+                released = not lease.spec.holder_identity
+                if not released and not self._expired(k, lease):
+                    continue
+                lease.spec.holder_identity = self.identity
+                lease.spec.acquire_time = now()
+                lease.spec.renew_time = now()
+                lease.spec.lease_transitions += 1
+                try:
+                    await self.client.update(lease)
+                    held.add(k)
+                    self.acquired_total += 1
+                    if not released:
+                        # taken from an expired HOLDER (worker death), not a
+                        # graceful release — the crash-reclaim counter
+                        self.adopted_total += 1
+                        log.info("shard-lease: adopted expired range %d", k)
+                except (ConflictError, NotFoundError):
+                    continue  # a survivor beat us to the corpse
+
+        self._apply_holdings(held)
+
+    async def _create(self, k: int) -> bool:
+        fresh = Lease(
+            metadata=ObjectMeta(name=self._lease_name(k),
+                                namespace=self.namespace),
+            spec=LeaseSpec(
+                holder_identity=self.identity,
+                lease_duration_seconds=max(
+                    1, math.ceil(self.lease_duration)),
+                acquire_time=now(), renew_time=now()))
+        try:
+            await self.client.create(fresh)
+            return True
+        except AlreadyExistsError:
+            return False
+
+    async def _release(self, k: int, lease: Optional[Lease] = None) -> None:
+        """Hand a range back (holder cleared, renew_time zeroed so the next
+        claimant needn't wait out the duration)."""
+        try:
+            if lease is None:
+                lease = await self.client.get(Lease, self._lease_name(k),
+                                              self.namespace)
+            if lease.spec.holder_identity != self.identity:
+                return
+            lease.spec.holder_identity = ""
+            lease.spec.renew_time = None
+            await self.client.update(lease)
+            self.released_total += 1
+        except (ConflictError, NotFoundError):
+            pass
+
+    def _apply_holdings(self, held: set[int]) -> None:
+        gained = held - self.ranges
+        lost = self.ranges - held
+        if not gained and not lost:
+            return
+        self.ranges = held
+        log.info("shard-lease %s: %d ranges held (+%d/-%d)", self.identity,
+                 len(held), len(gained), len(lost))
+        if self.on_change is not None:
+            try:
+                self.on_change(gained, lost)
+            except Exception:  # noqa: BLE001 — subscription refresh is
+                log.warning("shard-lease on_change failed",  # best-effort;
+                            exc_info=True)  # the next tick retries nothing
+
+
+def holders(leases: Iterable[Lease]) -> dict[str, set[int]]:
+    """holder identity → owned range ids, from a raw Lease listing (test
+    and supervisor-introspection helper)."""
+    out: dict[str, set[int]] = {}
+    for lease in leases:
+        name = lease.metadata.name
+        if not name.startswith(LEASE_PREFIX) or not lease.spec.holder_identity:
+            continue
+        try:
+            k = int(name[len(LEASE_PREFIX):])
+        except ValueError:
+            continue
+        out.setdefault(lease.spec.holder_identity, set()).add(k)
+    return out

@@ -38,10 +38,23 @@ SOURCE_TIMER = "timer"            # requeue_after safety net actually fired
 SOURCE_STOCKOUT = "stockout"      # placement stockout-TTL memo expired
 SOURCE_STATUS_FLUSH = "status-flush"  # batched status write landed
 SOURCE_INJECT = "inject"          # unattributed manual inject
+SOURCE_REMOTE = "remote"          # wake delivered over the IPC transport
+
+# Ledger key for safety-net timers that were never ARMED because an event
+# wake source is registered for the park reason (the timer-diet
+# optimization). Bookkeeping, not a delivered wake: timer_wake_share
+# denominators must exclude it.
+SKIPPED_TIMER_ARM = "timer-arm-skipped"
 
 
 def note_wake(source: str) -> None:
     WAKES[source] = WAKES.get(source, 0) + 1
+
+
+def note_skipped_arm() -> None:
+    """Count a safety-net timer the controller declined to arm because the
+    park's wake source is event-announced (see WakeHub.announce)."""
+    WAKES[SKIPPED_TIMER_ARM] = WAKES.get(SKIPPED_TIMER_ARM, 0) + 1
 
 
 WakeSink = Callable[..., Awaitable[None]]
@@ -65,9 +78,30 @@ class WakeHub:
         self._handles: set[asyncio.TimerHandle] = set()
         self._stopped = False
         self.delivered_total = 0
+        # Event wake sources ANNOUNCED as live producers on this hub: a
+        # controller park annotated with one of these can skip arming its
+        # safety-net timer (the timer-diet) — the producer will wake it.
+        self._announced: set[str] = set()
+        # Cross-process transport hook (runtime/shardipc.py): a sync
+        # callable ``route(name, source) -> bool``. Returning True claims
+        # the wake — it was forwarded to the owning worker process and must
+        # NOT deliver to local sinks (inject bypasses shard filters, so a
+        # local delivery of a foreign claim would violate single-writer).
+        self.route = None
+        self.forwarded_total = 0
 
     def register(self, sink: WakeSink) -> None:
         self._sinks.append(sink)
+
+    def announce(self, source: str) -> None:
+        """Declare that a producer for ``source`` is wired into this hub
+        (tracker completions for ``lro``, the Node watch for ``node``, the
+        status batcher for ``status-flush``, ...). Announcements gate the
+        safety-net timer diet — see ``Controller._worker``."""
+        self._announced.add(source)
+
+    def announced(self, source) -> bool:
+        return source in self._announced
 
     async def wake(self, name: str, source: str) -> None:
         """Deliver a wake for ``name`` to every registered sink NOW.
@@ -78,6 +112,16 @@ class WakeHub:
         """
         if self._stopped:
             return
+        if self.route is not None:
+            try:
+                claimed = self.route(name, source)
+            except Exception:  # noqa: BLE001 — transport loss ≠ lost wake:
+                claimed = False  # deliver locally; dedup makes it safe
+            if claimed:
+                self.forwarded_total += 1
+                probes.emit("hub-wake-forwarded", id(self), name=name,
+                            source=source)
+                return
         self.delivered_total += 1
         # schedfuzz stop-before-late-wake contract: emitted only for wakes
         # that actually deliver (a post-stop wake returns above, silently)

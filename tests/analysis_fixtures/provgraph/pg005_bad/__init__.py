@@ -1,0 +1,1 @@
+"""Fixture package: PG005 shard-isolation violation."""

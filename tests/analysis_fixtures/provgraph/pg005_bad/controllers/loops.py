@@ -1,0 +1,2 @@
+"""TP: a controller reaching into the shard IPC seam for live state."""
+from ..runtime import shardipc  # noqa: F401  (PG005: outside the seam)

@@ -1,0 +1,1 @@
+"""Fixture package: legal shard-seam wiring only."""

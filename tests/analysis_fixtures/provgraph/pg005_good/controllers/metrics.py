@@ -1,0 +1,2 @@
+"""TN: the /metrics scrape is a sanctioned read-only snapshot consumer."""
+from ..runtime import shardipc  # noqa: F401  (allowed: seam reader)

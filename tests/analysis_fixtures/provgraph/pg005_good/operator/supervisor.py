@@ -1,0 +1,2 @@
+"""TN: the composition root wires the seam together (seam member)."""
+from ..runtime import shardipc  # noqa: F401  (allowed: inside the seam)

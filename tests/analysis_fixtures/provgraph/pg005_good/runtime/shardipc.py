@@ -1,0 +1,3 @@
+"""Stand-in for the shard IPC transport (seam member)."""
+
+SERVERS = set()

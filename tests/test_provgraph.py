@@ -38,6 +38,7 @@ CASES = [
     ("PG002", "pg002", 1),
     ("PG003", "pg003", 1),
     ("PG004", "pg004", 2),   # one per direction
+    ("PG005", "pg005", 1),   # shard seam imported from outside it
 ]
 
 

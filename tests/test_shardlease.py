@@ -1,0 +1,257 @@
+"""Lease-based claim-range ownership (runtime/shardlease.py): fair-share
+split, no-stop rebalance on topology change, expiry adoption after a worker
+death, and the dequeue fence that closes the handoff window."""
+
+import asyncio
+
+import pytest
+
+from gpu_provisioner_tpu.apis.core import Lease
+from gpu_provisioner_tpu.runtime import Controller, InMemoryClient
+from gpu_provisioner_tpu.runtime.controller import Request, Result
+from gpu_provisioner_tpu.runtime.shardlease import (
+    NUM_RANGES, ShardLeaseTable, holders, range_of,
+)
+from gpu_provisioner_tpu.runtime.wakehub import (
+    SKIPPED_TIMER_ARM, SOURCE_LRO, WAKES, WakeHub,
+)
+
+from .conftest import async_test
+
+
+def fast_table(client, ident, target, **kw):
+    kw.setdefault("lease_duration", 0.4)
+    kw.setdefault("renew_interval", 0.05)
+    return ShardLeaseTable(client, identity=ident,
+                           target_workers=target, **kw)
+
+
+async def all_holders(client):
+    return holders(await client.list(Lease, namespace="kube-system"))
+
+
+def test_range_of_is_stable_and_bounded():
+    assert range_of("claim-0") == range_of("claim-0")
+    for name in (f"claim-{i}" for i in range(200)):
+        assert 0 <= range_of(name) < NUM_RANGES
+
+
+@async_test
+async def test_fair_share_split_covers_every_range():
+    client = InMemoryClient()
+    a = fast_table(client, "a", 2)
+    b = fast_table(client, "b", 2)
+    try:
+        await a.start()
+        await b.start()
+        for _ in range(40):
+            if len(a.ranges) == 32 and len(b.ranges) == 32:
+                break
+            await asyncio.sleep(0.05)
+        assert len(a.ranges) == 32 and len(b.ranges) == 32
+        assert a.ranges | b.ranges == set(range(NUM_RANGES))
+        assert not (a.ranges & b.ranges)
+        # every claim name has exactly one owner
+        for name in (f"claim-{i}" for i in range(100)):
+            assert a.owns(name) != b.owns(name)
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@async_test
+async def test_scale_up_rebalances_without_double_ownership():
+    """1 → 2 workers by lease handoff: at every observation point each
+    range has at most one holder (CAS guarantees it), and the steady state
+    is an exact fair-share split — the no-stop topology change."""
+    client = InMemoryClient()
+    a = fast_table(client, "a", 1)
+    try:
+        await a.start()
+        assert a.ranges == set(range(NUM_RANGES))
+        b = fast_table(client, "b", 2)
+        a.set_target_workers(2)
+        try:
+            await b.start()
+            for _ in range(60):
+                held = await all_holders(client)
+                total = sum(len(v) for v in held.values())
+                distinct = set().union(*held.values()) if held else set()
+                assert total == len(distinct), f"double-held range: {held}"
+                if (len(a.ranges) == 32 and len(b.ranges) == 32
+                        and a.ranges | b.ranges == set(range(NUM_RANGES))):
+                    break
+                await asyncio.sleep(0.05)
+            assert len(a.ranges) == 32 and len(b.ranges) == 32
+        finally:
+            await b.stop()
+    finally:
+        await a.stop()
+
+
+@async_test
+async def test_shrink_releases_for_instant_takeover():
+    """Graceful scale-down: the retiring table releases (renew_time zeroed)
+    so the survivor reclaims the ranges on its next tick — no expiry wait."""
+    client = InMemoryClient()
+    a = fast_table(client, "a", 2)
+    b = fast_table(client, "b", 2)
+    try:
+        await a.start()
+        await b.start()
+        for _ in range(40):
+            if len(a.ranges) == 32 and len(b.ranges) == 32:
+                break
+            await asyncio.sleep(0.05)
+        await b.stop(release=True)
+        assert b.released_total >= 32
+        a.set_target_workers(1)
+        for _ in range(40):
+            if a.ranges == set(range(NUM_RANGES)):
+                break
+            await asyncio.sleep(0.05)
+        assert a.ranges == set(range(NUM_RANGES))
+        # released-not-expired ranges are plain acquires, not adoptions
+        assert a.adopted_total == 0
+    finally:
+        await a.stop()
+
+
+@async_test
+async def test_dead_worker_ranges_adopted_after_expiry():
+    """SIGKILL analog: the table stops renewing WITHOUT releasing. A
+    survivor adopts every expired range once the duration passes — claims
+    are reclaimed, not orphaned."""
+    client = InMemoryClient()
+    a = fast_table(client, "a", 1, lease_duration=0.3)
+    await a.start()
+    await a.stop(release=False)  # death: renew loop gone, leases still held
+    b = fast_table(client, "b", 1, lease_duration=0.3)
+    try:
+        await b.start()
+        assert b.ranges == set(), "must not steal an unexpired lease"
+        for _ in range(60):
+            if b.ranges == set(range(NUM_RANGES)):
+                break
+            await asyncio.sleep(0.05)
+        assert b.ranges == set(range(NUM_RANGES))
+        assert b.adopted_total == NUM_RANGES
+        held = await all_holders(client)
+        assert set(held) == {"b"}
+    finally:
+        await b.stop()
+
+
+@async_test
+async def test_on_change_fires_with_gained_and_lost_sets():
+    client = InMemoryClient()
+    events = []
+    a = fast_table(client, "a", 1,
+                   on_change=lambda g, l: events.append((set(g), set(l))))
+    try:
+        await a.start()
+        assert events and events[0][0] == set(range(NUM_RANGES))
+        a.set_target_workers(4)  # share shrinks 64 → 16: ranges released
+        for _ in range(40):
+            if len(a.ranges) == 16:
+                break
+            await asyncio.sleep(0.05)
+        lost = set().union(*(l for _, l in events))
+        assert len(a.ranges) == 16 and len(lost) == 48
+    finally:
+        await a.stop()
+
+
+# ---------------------------------------------------------- handoff fences
+
+@async_test
+async def test_dequeue_fence_drops_disowned_item_exactly_once():
+    """The handoff window: an item enqueued while this worker owned its
+    range, dequeued after the lease moved, must DROP (the new owner's
+    replay re-drives it) — reconciling would double-write."""
+    reconciled = []
+
+    class R:
+        async def reconcile(self, req):
+            reconciled.append(req.name)
+            return Result()
+
+    owned = {"mine"}
+    c = Controller("t", R(), max_concurrent=1)
+    c.owns = lambda name: name in owned
+    await c.queue.add(Request(name="mine"))
+    await c.queue.add(Request(name="foreign"))
+    tasks = [asyncio.create_task(c._worker())]
+    try:
+        for _ in range(100):
+            if c.disowned_total:
+                break
+            await asyncio.sleep(0.01)
+        assert reconciled == ["mine"]
+        assert c.disowned_total == 1
+    finally:
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            with pytest.raises(asyncio.CancelledError):
+                await t
+
+
+@async_test
+async def test_timer_diet_skips_arm_for_announced_source():
+    """Satellite 1: a park annotated with an event wake source whose
+    producer is announced on the hub skips the safety-net timer arm, and
+    the skip lands in the WAKES ledger (not as a delivered wake)."""
+    parked = asyncio.Event()
+
+    class R:
+        async def reconcile(self, req):
+            parked.set()
+            return Result(requeue_after=30.0, wake_source=SOURCE_LRO)
+
+    hub = WakeHub()
+    hub.announce(SOURCE_LRO)
+    c = Controller("t", R(), max_concurrent=1)
+    c.wake_hub = hub
+    before = WAKES.get(SKIPPED_TIMER_ARM, 0)
+    await c.queue.add(Request(name="x"))
+    task = asyncio.create_task(c._worker())
+    try:
+        await asyncio.wait_for(parked.wait(), timeout=5)
+        await asyncio.sleep(0.05)
+        assert WAKES.get(SKIPPED_TIMER_ARM, 0) == before + 1
+        assert c.queue.delayed() == 0, "safety-net timer must NOT be armed"
+    finally:
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+    await c.queue.shutdown()
+
+
+@async_test
+async def test_timer_diet_arms_fallback_not_full_requeue():
+    """The un-sourced residue of a folded park (liveness budget) still
+    arms — the diet removes redundant timers, never the last-resort one."""
+    parked = asyncio.Event()
+
+    class R:
+        async def reconcile(self, req):
+            parked.set()
+            return Result(requeue_after=30.0, wake_source=SOURCE_LRO,
+                          fallback_after=600.0)
+
+    hub = WakeHub()
+    hub.announce(SOURCE_LRO)
+    c = Controller("t", R(), max_concurrent=1)
+    c.wake_hub = hub
+    await c.queue.add(Request(name="x"))
+    task = asyncio.create_task(c._worker())
+    try:
+        await asyncio.wait_for(parked.wait(), timeout=5)
+        await asyncio.sleep(0.05)
+        assert c.queue.delayed() == 1, "fallback deadline must stay armed"
+    finally:
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+    await c.queue.shutdown()
